@@ -1,0 +1,49 @@
+"""mpi_tpu — a TPU-native stencil / cellular-automaton framework.
+
+A from-scratch re-design of the capabilities of the reference MPI
+Game-of-Life code (``/root/reference``, see ``SURVEY.md``): spatial domain
+decomposition with ghost-cell (halo) exchange, a serial oracle, snapshot
+I/O + visualization, and a benchmarking harness — rebuilt TPU-first:
+
+* the per-cell B3/S23 update (reference ``main.cpp:79-103``) becomes a
+  vectorized separable window-sum on the VPU (``ops/stencil.py``), with a
+  fused Pallas kernel (``ops/pallas_stencil.py``) as the hot path;
+* the MPI halo exchange (reference ``main.cpp:36-65``) becomes
+  ``jax.lax.ppermute`` shifts inside ``shard_map`` over an ICI device
+  mesh (``parallel/halo.py``);
+* the 2D Cartesian process mesh (reference ``main.cpp:239-261``) becomes
+  a ``jax.sharding.Mesh`` (``parallel/mesh.py``);
+* the serial C++ oracle and the native multi-worker runtime live in
+  ``backends/native`` (C++, loaded via ctypes) — the native layer the
+  reference implements with MPI.
+
+Modules land incrementally; see ``git log`` for what is built so far.
+
+Everything shares one decomposition-invariant initialization
+(``utils/hashinit.py``) so serial, native-C++, and TPU backends produce
+bit-identical grids for the same configuration.
+"""
+
+from mpi_tpu.config import GolConfig
+from mpi_tpu.models.rules import (
+    Rule,
+    LIFE,
+    HIGHLIFE,
+    SEEDS,
+    DAY_AND_NIGHT,
+    BOSCO,
+    rule_from_name,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GolConfig",
+    "Rule",
+    "LIFE",
+    "HIGHLIFE",
+    "SEEDS",
+    "DAY_AND_NIGHT",
+    "BOSCO",
+    "rule_from_name",
+]

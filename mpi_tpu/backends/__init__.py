@@ -1,0 +1,1 @@
+"""Execution backends: numpy serial oracle, native C++ engines, TPU."""

@@ -1,0 +1,67 @@
+"""Pure-numpy serial oracle — the known-good baseline every other backend
+is tested against (the role ``/root/reference/main_serial.cpp`` plays for
+the reference, SURVEY.md §4.1).
+
+Deliberately implemented with a *different algorithm* from the JAX path
+(`mpi_tpu.ops.stencil` uses separable window sums + interval compares;
+this uses a full non-separable shifted-add sum + rule table lookup) so the
+cross-backend parity tests compare independent derivations, not the same
+code twice.
+
+Fixes vs the reference oracle, documented for parity auditing:
+* boundary is a flag (reference serial is periodic-only, ``main_serial.cpp:57``);
+* no init/update index mismatch (reference quirk #3: init fills [0,n) while
+  update reads [1,n], leaving an uninitialized edge);
+* init is the shared decomposition-invariant hash, not ``srand`` sequences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_tpu.models.rules import Rule, LIFE
+from mpi_tpu.utils.hashinit import init_tile_np
+
+
+def counts_np(grid: np.ndarray, radius: int, boundary: str) -> np.ndarray:
+    """Neighbor counts (center excluded), full (2r+1)² shifted-add sum."""
+    r = radius
+    if boundary == "periodic":
+        p = np.pad(grid, r, mode="wrap")
+    elif boundary == "dead":
+        p = np.pad(grid, r, mode="constant")
+    else:
+        raise ValueError(f"unknown boundary {boundary!r}")
+    H, W = grid.shape
+    c = np.zeros((H, W), dtype=np.uint8)
+    for di in range(2 * r + 1):
+        for dj in range(2 * r + 1):
+            if di == r and dj == r:
+                continue
+            c += p[di : di + H, dj : dj + W]
+    return c
+
+
+def step_np(grid: np.ndarray, rule: Rule = LIFE, boundary: str = "periodic") -> np.ndarray:
+    """One generation, via rule lookup tables."""
+    c = counts_np(grid, rule.radius, boundary)
+    birth_table, survive_table = rule.tables()
+    alive = grid.astype(bool)
+    return np.where(alive, survive_table[c], birth_table[c]).astype(np.uint8)
+
+
+def evolve_np(
+    grid: np.ndarray,
+    steps: int,
+    rule: Rule = LIFE,
+    boundary: str = "periodic",
+) -> np.ndarray:
+    for _ in range(steps):
+        grid = step_np(grid, rule, boundary)
+    return grid
+
+
+def run_serial(config) -> np.ndarray:
+    """Init + evolve per a GolConfig; returns the final grid."""
+    grid = init_tile_np(config.rows, config.cols, config.seed)
+    return evolve_np(grid, config.steps, config.rule, config.boundary)

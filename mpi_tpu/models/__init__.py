@@ -1,0 +1,21 @@
+"""Cellular-automaton model families (rule definitions)."""
+
+from mpi_tpu.models.rules import (
+    Rule,
+    LIFE,
+    HIGHLIFE,
+    SEEDS,
+    DAY_AND_NIGHT,
+    BOSCO,
+    rule_from_name,
+)
+
+__all__ = [
+    "Rule",
+    "LIFE",
+    "HIGHLIFE",
+    "SEEDS",
+    "DAY_AND_NIGHT",
+    "BOSCO",
+    "rule_from_name",
+]

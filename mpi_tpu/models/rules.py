@@ -1,0 +1,154 @@
+"""Totalistic cellular-automaton rules (outer-totalistic, Moore neighborhood).
+
+The reference hardcodes Conway's B3/S23 in two places (``next()`` at
+``/root/reference/main.cpp:79-90`` and the count/apply passes at
+``/root/reference/main_serial.cpp:45-71``).  Here the rule is data: a pair of
+neighbor-count sets (birth, survive) plus a neighborhood radius, which
+generalizes to HighLife, Seeds, Day & Night, and Larger-than-Life-style
+radius-r rules with one code path in every backend.
+
+Convention: the neighbor count is over the *extended Moore neighborhood
+excluding the center cell* — ``(2r+1)² − 1`` neighbors.  This matches the
+reference's ``next()`` (8-neighbor sum, center excluded).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, List, Tuple
+
+
+def _intervals(counts: Iterable[int]) -> Tuple[Tuple[int, int], ...]:
+    """Compress a set of ints into sorted, inclusive (lo, hi) intervals.
+
+    Backends apply rules as OR-of-range-tests (vectorizes as comparisons —
+    no gathers on the VPU), so contiguous runs are collapsed.
+    """
+    s = sorted(set(int(c) for c in counts))
+    if not s:
+        return ()
+    out: List[Tuple[int, int]] = []
+    lo = hi = s[0]
+    for c in s[1:]:
+        if c == hi + 1:
+            hi = c
+        else:
+            out.append((lo, hi))
+            lo = hi = c
+    out.append((lo, hi))
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """An outer-totalistic rule: born on counts in `birth`, stays alive on
+    counts in `survive`, over a radius-`radius` Moore neighborhood."""
+
+    name: str
+    birth: frozenset = field(default_factory=frozenset)
+    survive: frozenset = field(default_factory=frozenset)
+    radius: int = 1
+
+    def __post_init__(self):
+        object.__setattr__(self, "birth", frozenset(int(b) for b in self.birth))
+        object.__setattr__(self, "survive", frozenset(int(s) for s in self.survive))
+        nmax = self.max_count
+        if self.radius < 1:
+            raise ValueError(f"radius must be >= 1, got {self.radius}")
+        if self.radius > 7:
+            # Backends accumulate neighbor counts in uint8; r=7 gives a max
+            # count of 224, r=8 would give 288 and wrap silently.
+            raise ValueError(
+                f"radius must be <= 7 (uint8 count accumulators), got {self.radius}"
+            )
+        for c in self.birth | self.survive:
+            if not (0 <= c <= nmax):
+                raise ValueError(
+                    f"rule {self.name!r}: count {c} out of range [0, {nmax}] "
+                    f"for radius {self.radius}"
+                )
+
+    @property
+    def max_count(self) -> int:
+        """Largest possible neighbor count: (2r+1)² − 1."""
+        side = 2 * self.radius + 1
+        return side * side - 1
+
+    @property
+    def birth_intervals(self) -> Tuple[Tuple[int, int], ...]:
+        return _intervals(self.birth)
+
+    @property
+    def survive_intervals(self) -> Tuple[Tuple[int, int], ...]:
+        return _intervals(self.survive)
+
+    def tables(self):
+        """(birth_table, survive_table) as length-(max_count+1) uint8 numpy
+        arrays — the form the native C++ engine consumes."""
+        import numpy as np
+
+        n = self.max_count + 1
+        bt = np.zeros(n, dtype=np.uint8)
+        st = np.zeros(n, dtype=np.uint8)
+        for c in self.birth:
+            bt[c] = 1
+        for c in self.survive:
+            st[c] = 1
+        return bt, st
+
+    def __str__(self) -> str:
+        b = "".join(str(c) for c in sorted(self.birth)) if self.radius == 1 else repr(sorted(self.birth))
+        s = "".join(str(c) for c in sorted(self.survive)) if self.radius == 1 else repr(sorted(self.survive))
+        return f"{self.name} (B{b}/S{s}, r={self.radius})"
+
+
+# The classic rules (radius 1, 8 neighbors).
+LIFE = Rule("life", frozenset({3}), frozenset({2, 3}))
+HIGHLIFE = Rule("highlife", frozenset({3, 6}), frozenset({2, 3}))
+SEEDS = Rule("seeds", frozenset({2}), frozenset())
+DAY_AND_NIGHT = Rule("daynight", frozenset({3, 6, 7, 8}), frozenset({3, 4, 6, 7, 8}))
+
+# Larger-than-Life: "Bosco's rule", radius 5 (120 neighbors, center excluded).
+# Standard statement counts the center: born 34..45, survive 34..58 of 121.
+# With the center excluded, survival of a live cell shifts down by one.
+BOSCO = Rule("bosco", frozenset(range(34, 46)), frozenset(range(33, 58)), radius=5)
+
+_REGISTRY = {r.name: r for r in (LIFE, HIGHLIFE, SEEDS, DAY_AND_NIGHT, BOSCO)}
+
+
+def rule_from_name(name: str) -> Rule:
+    """Look up a built-in rule, or parse a 'B3/S23' / 'B36/S23' style string
+    (radius-1) or 'R5,B34-45,S33-57' Larger-than-Life style string."""
+    key = name.lower()
+    if key in _REGISTRY:
+        return _REGISTRY[key]
+    if key.startswith("b") and "/s" in key:
+        bpart, spart = key[1:].split("/s", 1)
+        return Rule(
+            name,
+            frozenset(int(ch) for ch in bpart if ch.isdigit()),
+            frozenset(int(ch) for ch in spart if ch.isdigit()),
+        )
+    if key.startswith("r") and ",b" in key:
+        try:
+            rpart, bpart, spart = key.split(",")
+            radius = int(rpart[1:])
+
+            def parse_range(p: str) -> frozenset:
+                p = p[1:]  # strip leading b/s
+                out = set()
+                for piece in p.split("+"):
+                    if "-" in piece:
+                        lo, hi = piece.split("-")
+                        out.update(range(int(lo), int(hi) + 1))
+                    elif piece:
+                        out.add(int(piece))
+                return frozenset(out)
+
+            return Rule(name, parse_range(bpart), parse_range(spart), radius=radius)
+        except (ValueError, IndexError) as e:
+            raise ValueError(f"cannot parse rule string {name!r}") from e
+    raise ValueError(
+        f"unknown rule {name!r}; built-ins: {sorted(_REGISTRY)}; "
+        "or use 'B3/S23' / 'R5,B34-45,S33-57' syntax"
+    )

@@ -1,0 +1,19 @@
+"""Compute ops: dense stencil (XLA shift-add) and Pallas TPU kernels."""
+
+from mpi_tpu.ops.stencil import (
+    pad_grid,
+    counts_from_padded,
+    neighbor_counts,
+    apply_rule,
+    step,
+    make_stepper,
+)
+
+__all__ = [
+    "pad_grid",
+    "counts_from_padded",
+    "neighbor_counts",
+    "apply_rule",
+    "step",
+    "make_stepper",
+]

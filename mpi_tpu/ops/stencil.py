@@ -1,0 +1,115 @@
+"""Dense stencil step — the TPU-native equivalent of the reference's
+per-cell sweep (``/root/reference/main.cpp:79-103`` gather flavor,
+``/root/reference/main_serial.cpp:45-71`` scatter flavor).
+
+Design (TPU-first, not a translation):
+
+* The neighbor count is a **separable box sum**: a (2r+1)-tap window sum
+  over rows, then over columns, minus the center — ``2·(2r+1)`` shifted
+  uint8 adds instead of ``(2r+1)²`` per-cell gathers.  Everything is
+  elementwise on static shapes, so XLA fuses the whole step (pad → sums →
+  rule select) into one VPU loop over (8, 128) registers; no scalar code,
+  no gathers, no MXU needed.
+* The rule is applied as OR-of-interval comparisons (``Rule.*_intervals``)
+  — comparisons and selects, which fuse into the same loop.
+* Multi-step evolution is ``lax.scan`` under ``jit`` with donated carry:
+  the double-buffer pointer swap of the reference (``main.cpp:294-296``)
+  becomes XLA buffer donation — same memory behavior, no aliasing bugs
+  possible (SURVEY.md §5.2).
+
+Grids are uint8 0/1 arrays.  uint8 is the natural VPU lane type here; the
+max neighbor count for r≤5 (120) fits comfortably.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_tpu.models.rules import Rule, LIFE
+
+Boundary = str  # "periodic" | "dead"
+
+
+def pad_grid(grid: jax.Array, radius: int, boundary: Boundary) -> jax.Array:
+    """Pad a (H, W) grid with a radius-wide ring: toroidal wrap for
+    "periodic" (serial oracle semantics, ``main_serial.cpp:57``) or zeros
+    for "dead" (MPI program's non-periodic edges, ``main.cpp:243``)."""
+    if boundary == "periodic":
+        return jnp.pad(grid, radius, mode="wrap")
+    if boundary == "dead":
+        return jnp.pad(grid, radius, mode="constant", constant_values=0)
+    raise ValueError(f"unknown boundary {boundary!r}")
+
+
+def counts_from_padded(padded: jax.Array, radius: int) -> jax.Array:
+    """Neighbor counts (center excluded) for the interior of a pre-padded
+    array.  padded: (H+2r, W+2r) uint8 → (H, W) uint8.
+
+    Separable: rowsum over the vertical window first (keeps full padded
+    width so the column pass sees horizontally-shifted values), then the
+    horizontal window, then subtract the center cell.
+    """
+    r = radius
+    H = padded.shape[0] - 2 * r
+    W = padded.shape[1] - 2 * r
+    win = 2 * r + 1
+    rowsum = padded[0:H, :]
+    for k in range(1, win):
+        rowsum = rowsum + padded[k : k + H, :]
+    counts = rowsum[:, 0:W]
+    for k in range(1, win):
+        counts = counts + rowsum[:, k : k + W]
+    return counts - padded[r : r + H, r : r + W]
+
+
+def neighbor_counts(grid: jax.Array, radius: int, boundary: Boundary) -> jax.Array:
+    return counts_from_padded(pad_grid(grid, radius, boundary), radius)
+
+
+def _in_any_interval(counts: jax.Array, intervals: Tuple[Tuple[int, int], ...]) -> jax.Array:
+    if not intervals:
+        return jnp.zeros(counts.shape, dtype=jnp.bool_)
+    acc = None
+    for lo, hi in intervals:
+        if lo == hi:
+            t = counts == jnp.uint8(lo)
+        else:
+            t = (counts >= jnp.uint8(lo)) & (counts <= jnp.uint8(hi))
+        acc = t if acc is None else acc | t
+    return acc
+
+
+def apply_rule(alive: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
+    """Next state from current state + neighbor counts: B/S select."""
+    born = _in_any_interval(counts, rule.birth_intervals)
+    keep = _in_any_interval(counts, rule.survive_intervals)
+    return jnp.where(alive.astype(jnp.bool_), keep, born).astype(jnp.uint8)
+
+
+def step(grid: jax.Array, rule: Rule = LIFE, boundary: Boundary = "periodic") -> jax.Array:
+    """One generation on a single device."""
+    counts = neighbor_counts(grid, rule.radius, boundary)
+    return apply_rule(grid, counts, rule)
+
+
+@functools.partial(jax.jit, static_argnames=("rule", "boundary", "steps"), donate_argnums=0)
+def _evolve(grid: jax.Array, rule: Rule, boundary: Boundary, steps: int) -> jax.Array:
+    def body(g, _):
+        return step(g, rule, boundary), None
+
+    out, _ = lax.scan(body, grid, None, length=steps)
+    return out
+
+
+def make_stepper(rule: Rule = LIFE, boundary: Boundary = "periodic"):
+    """Returns evolve(grid, steps) — jitted scan with donated carry."""
+
+    def evolve(grid: jax.Array, steps: int) -> jax.Array:
+        return _evolve(grid, rule, boundary, steps)
+
+    return evolve

@@ -1,0 +1,7 @@
+"""Distributed execution: device mesh, halo exchange, sharded stepper."""
+
+from mpi_tpu.parallel.mesh import make_mesh
+from mpi_tpu.parallel.halo import exchange_halo
+from mpi_tpu.parallel.step import make_sharded_stepper, sharded_init
+
+__all__ = ["make_mesh", "exchange_halo", "make_sharded_stepper", "sharded_init"]

@@ -1,0 +1,68 @@
+"""Ghost-cell (halo) exchange via ``jax.lax.ppermute`` — the TPU-native
+replacement for the reference's MPI point-to-point exchange
+(``distr_borders``, ``/root/reference/main.cpp:36-65``).
+
+Mechanism mapping (SURVEY.md §5.8):
+
+* ``MPI_Isend/Irecv`` of strided column types / contiguous rows →
+  ``lax.ppermute`` ring shifts along a mesh axis (ICI nearest-neighbor
+  transfers on real hardware);
+* the reference's two-phase ordering — columns first, then rows *including
+  the just-received ghost columns* so corners propagate diagonally — is
+  kept, but phase order flipped (rows first, then width-extended columns);
+  either order transfers the corner blocks in two phases;
+* ``MPI_PROC_NULL`` no-op sends at non-periodic edges → ``ppermute``'s
+  semantics of delivering **zeros** to devices that appear in no
+  (src, dst) pair: for ``boundary="dead"`` we simply omit the wraparound
+  pairs and the edge ghosts arrive as zeros, which is exactly the dead
+  boundary condition.  Periodic closes the ring instead.
+
+Unlike the reference, the pairing is correct: the reference sends its left
+edge to its *right* neighbor's right ghost (mirrored halos, SURVEY.md §5.8
+quirk #1); here ghosts always hold the geometrically adjacent neighbor's
+edge, and the parity tests vs the serial oracle prove it.
+
+Halo width = rule radius (r-deep ghost rings for Larger-than-Life), the
+generalization the reference's 1-cell halo hardcodes away.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_tpu.parallel.mesh import AXES
+
+
+def _axis_exchange(x, axis_name: str, spatial_axis: int, radius: int, periodic: bool):
+    """Extend x by radius ghost slices on both ends of spatial_axis, filled
+    from the previous/next shard along mesh axis axis_name."""
+    n = lax.axis_size(axis_name)
+    size = x.shape[spatial_axis]
+    first = lax.slice_in_dim(x, 0, radius, axis=spatial_axis)
+    last = lax.slice_in_dim(x, size - radius, size, axis=spatial_axis)
+    if n == 1:
+        if periodic:
+            before, after = last, first          # wrap onto itself
+        else:
+            before, after = jnp.zeros_like(last), jnp.zeros_like(first)
+    else:
+        fwd = [(k, k + 1) for k in range(n - 1)]
+        bwd = [(k, k - 1) for k in range(1, n)]
+        if periodic:
+            fwd.append((n - 1, 0))
+            bwd.append((0, n - 1))
+        # before-ghost = previous shard's last rows; after-ghost = next
+        # shard's first rows.  Missing pairs (dead boundary) yield zeros.
+        before = lax.ppermute(last, axis_name, fwd)
+        after = lax.ppermute(first, axis_name, bwd)
+    return jnp.concatenate([before, x, after], axis=spatial_axis)
+
+
+def exchange_halo(local, radius: int, boundary: str, axes=AXES):
+    """(h, w) shard → (h+2r, w+2r) with ghost ring filled.  Must be called
+    inside ``shard_map`` over a mesh with the given axis names.  Rows phase
+    then columns phase on the row-extended array → corners correct."""
+    periodic = boundary == "periodic"
+    x = _axis_exchange(local, axes[0], 0, radius, periodic)
+    return _axis_exchange(x, axes[1], 1, radius, periodic)

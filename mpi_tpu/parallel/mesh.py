@@ -1,0 +1,57 @@
+"""Device-mesh construction — the TPU-native equivalent of the reference's
+2D Cartesian MPI topology (``MPI_Dims_create`` + ``MPI_Cart_create`` with
+``reorder=1``, ``/root/reference/main.cpp:242-250``).
+
+``mesh_utils.create_device_mesh`` plays the role of ``reorder=1``: it
+permutes devices so that mesh-adjacent shards are ICI-adjacent chips, which
+is what keeps the halo ``ppermute`` traffic on nearest-neighbor links.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS_ROWS = "gi"   # mesh axis sharding grid rows
+AXIS_COLS = "gj"   # mesh axis sharding grid cols
+AXES: Tuple[str, str] = (AXIS_ROWS, AXIS_COLS)
+
+
+def choose_mesh_shape(n_devices: int) -> Tuple[int, int]:
+    """Most-square 2D factorization of n (the ``MPI_Dims_create`` analog).
+
+    Prefers shapes like (2,4) over (1,8): a squarer mesh halves halo bytes
+    per shard at large grids (perimeter vs area).
+    """
+    best = (1, n_devices)
+    for a in range(1, int(np.sqrt(n_devices)) + 1):
+        if n_devices % a == 0:
+            best = (a, n_devices // a)
+    return best
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Tuple[str, str] = AXES,
+) -> Mesh:
+    """A 2D Mesh over the given (default: all) devices.  shape=None picks
+    the most-square factorization; (n, 1) / (1, n) give 1D row / column
+    decomposition."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = choose_mesh_shape(n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    if devices[0].platform == "cpu":
+        # Virtual CPU devices (tests) have no ICI topology to optimize over.
+        dev_array = np.asarray(devices).reshape(shape)
+    else:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(dev_array, axis_names)

@@ -1,0 +1,84 @@
+"""Sharded evolution: shard_map(halo exchange + local stencil) under a
+jitted scan — the driver loop of the reference (``main.cpp:291-305``)
+re-expressed as one compiled program.
+
+The reference's per-step ``MPI_Barrier`` (``main.cpp:297``) has no
+equivalent here: inside jit, data dependence between the ppermute and the
+stencil orders everything (SURVEY.md §5.8 barrier row).  The double-buffer
+pointer swap (``main.cpp:294-296``) is buffer donation on the scan carry.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+try:  # jax.shard_map is the public name on recent JAX
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from mpi_tpu.models.rules import Rule
+from mpi_tpu.ops.stencil import counts_from_padded, apply_rule
+from mpi_tpu.parallel.halo import exchange_halo
+from mpi_tpu.parallel.mesh import AXES
+from mpi_tpu.utils.hashinit import init_tile_jnp
+
+
+def grid_sharding(mesh: Mesh, axes=AXES) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*axes))
+
+
+def make_sharded_stepper(mesh: Mesh, rule: Rule, boundary: str, axes=AXES):
+    """Returns evolve(grid, steps) running shard-parallel over the mesh.
+
+    grid must be (rows, cols) uint8, rows % mesh[axes[0]] == 0 and
+    cols % mesh[axes[1]] == 0; output keeps the same sharding.
+    """
+    spec = PartitionSpec(*axes)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=spec, out_specs=spec
+    )
+    def local_step(local):
+        padded = exchange_halo(local, rule.radius, boundary, axes)
+        counts = counts_from_padded(padded, rule.radius)
+        return apply_rule(local, counts, rule)
+
+    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
+    def evolve(grid, steps: int):
+        def body(g, _):
+            return local_step(g), None
+
+        out, _ = lax.scan(body, grid, None, length=steps)
+        return out
+
+    return evolve
+
+
+def sharded_init(mesh: Mesh, rows: int, cols: int, seed: int, axes=AXES):
+    """Initialize the grid directly on-device, each shard hashing its own
+    global coordinates — no host-side global array, no scatter.  This is
+    how a 65536² grid comes up without ever existing on one host."""
+    mi = mesh.shape[axes[0]]
+    mj = mesh.shape[axes[1]]
+    if rows % mi or cols % mj:
+        raise ValueError(f"mesh {dict(mesh.shape)} does not divide grid {rows}x{cols}")
+    lr, lc = rows // mi, cols // mj
+    spec = PartitionSpec(*axes)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(), out_specs=spec)
+    def init():
+        ti = lax.axis_index(axes[0])
+        tj = lax.axis_index(axes[1])
+        return init_tile_jnp(
+            lr, lc, seed,
+            row_offset=ti.astype(jnp.uint32) * jnp.uint32(lr),
+            col_offset=tj.astype(jnp.uint32) * jnp.uint32(lc),
+        )
+
+    return jax.jit(init, out_shardings=grid_sharding(mesh, axes))()

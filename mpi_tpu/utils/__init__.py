@@ -1,0 +1,1 @@
+"""Shared utilities: deterministic init, timing, validation, logging."""

@@ -1,0 +1,94 @@
+"""Decomposition-invariant grid initialization.
+
+The reference seeds each MPI rank with ``srand(rank)`` (``main.cpp:70``) and
+the serial program with ``srand(seed)`` (``main_serial.cpp:36``), so the
+initial state depends on the process count and the two programs never agree
+(SURVEY.md §5.8 quirk #4).  This framework replaces sequential libc ``rand``
+with a *counter-based* hash keyed on the global cell coordinate: cell (i, j)
+is alive iff ``fmix32-chain(seed, i, j) % 3 == 0`` (P(alive) = 1/3, matching
+the reference's ``rand() % 3 == 0`` density, ``main.cpp:69-73``).
+
+Because the hash depends only on (seed, global i, global j), every backend —
+numpy serial, native C++, single-chip TPU, and any shard of any device mesh —
+computes bit-identical initial grids, which is what makes cross-backend
+final-grid parity testable.  The native C++ engine implements the same
+function; parity tests pin numpy == JAX == C++ equality.
+
+The mixer is murmur3's 32-bit finalizer (public domain), applied twice with
+the row/column keys folded in via odd multiplicative constants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Odd constants: golden-ratio Weyl constant and murmur3 finalizer constants.
+_KI = 0x9E3779B1
+_KJ = 0x85EBCA77
+_M1 = 0x85EBCA6B
+_M2 = 0xC2B2AE35
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    """murmur3 32-bit avalanche finalizer on uint32 arrays (wrapping)."""
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(_M1)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(_M2)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def cell_hash_np(seed: int, i: np.ndarray, j: np.ndarray) -> np.ndarray:
+    """uint32 hash of (seed, i, j); i/j broadcastable integer arrays."""
+    i = i.astype(np.uint32) * np.uint32(_KI)
+    j = j.astype(np.uint32) * np.uint32(_KJ)
+    h = _fmix32_np(np.uint32(seed) ^ i)
+    return _fmix32_np(h ^ j)
+
+
+def init_tile_np(
+    rows: int,
+    cols: int,
+    seed: int,
+    row_offset: int = 0,
+    col_offset: int = 0,
+) -> np.ndarray:
+    """A (rows, cols) uint8 0/1 tile of the global grid starting at
+    (row_offset, col_offset).  Decomposition-invariant: stitching tiles of
+    any shape reproduces ``init_tile_np(R, C, seed)`` exactly."""
+    i = np.arange(row_offset, row_offset + rows, dtype=np.uint32)[:, None]
+    j = np.arange(col_offset, col_offset + cols, dtype=np.uint32)[None, :]
+    h = cell_hash_np(seed, i, j)
+    return (h % np.uint32(3) == 0).astype(np.uint8)
+
+
+def _fmix32_jnp(h):
+    import jax.numpy as jnp
+
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(_M1)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(_M2)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def init_tile_jnp(
+    rows: int,
+    cols: int,
+    seed: int,
+    row_offset=0,
+    col_offset=0,
+):
+    """JAX twin of :func:`init_tile_np`; traceable (offsets may be tracers,
+    e.g. derived from ``lax.axis_index`` inside ``shard_map``)."""
+    import jax.numpy as jnp
+
+    i = (jnp.uint32(row_offset) + jnp.arange(rows, dtype=jnp.uint32))[:, None]
+    j = (jnp.uint32(col_offset) + jnp.arange(cols, dtype=jnp.uint32))[None, :]
+    i = i * jnp.uint32(_KI)
+    j = j * jnp.uint32(_KJ)
+    h = _fmix32_jnp(jnp.uint32(seed) ^ i)
+    h = _fmix32_jnp(h ^ j)
+    return (h % jnp.uint32(3) == 0).astype(jnp.uint8)

@@ -1,0 +1,24 @@
+"""Test harness config: force JAX onto CPU with 8 virtual devices so the
+sharded (ICI-mesh) code paths run without TPU hardware — the framework's
+version of the reference's oversubscribed-mpirun smoke testing
+(/root/reference/run.sh:4-5; SURVEY.md §4.2).
+
+Must run before any test module imports jax.
+"""
+
+import os
+
+# Hard override: the ambient environment pins JAX to the real TPU (the axon
+# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") at
+# interpreter start, which trumps the env var); tests always run on the
+# virtual CPU mesh, so force the config back before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,109 @@
+"""Rule parsing/representation + known-pattern behavior of the oracle."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu.models.rules import (
+    Rule, LIFE, HIGHLIFE, SEEDS, BOSCO, rule_from_name, _intervals,
+)
+from mpi_tpu.backends.serial_np import step_np, evolve_np
+
+
+def test_intervals_compression():
+    assert _intervals({2, 3}) == ((2, 3),)
+    assert _intervals({3, 6}) == ((3, 3), (6, 6))
+    assert _intervals(range(34, 46)) == ((34, 45),)
+    assert _intervals([]) == ()
+
+
+def test_rule_from_name_builtin():
+    assert rule_from_name("life") is LIFE
+    assert rule_from_name("bosco") is BOSCO
+
+
+def test_rule_from_bs_string():
+    r = rule_from_name("B36/S23")
+    assert r.birth == frozenset({3, 6})
+    assert r.survive == frozenset({2, 3})
+    assert r.radius == 1
+
+
+def test_rule_from_ltl_string():
+    r = rule_from_name("R5,B34-45,S33-57")
+    assert r.radius == 5
+    assert r.birth == frozenset(range(34, 46))
+    assert r.survive == frozenset(range(33, 58))
+
+
+def test_rule_count_range_validated():
+    with pytest.raises(ValueError):
+        Rule("bad", frozenset({9}), frozenset())  # max count for r=1 is 8
+
+
+def test_tables():
+    bt, st = LIFE.tables()
+    assert bt.tolist() == [0, 0, 0, 1, 0, 0, 0, 0, 0]
+    assert st.tolist() == [0, 0, 1, 1, 0, 0, 0, 0, 0]
+
+
+def _place(pattern, size=16, at=(5, 5)):
+    g = np.zeros((size, size), dtype=np.uint8)
+    p = np.array(pattern, dtype=np.uint8)
+    g[at[0] : at[0] + p.shape[0], at[1] : at[1] + p.shape[1]] = p
+    return g
+
+
+def test_blinker_period_2():
+    g = _place([[1, 1, 1]])
+    g1 = step_np(g, LIFE, "periodic")
+    g2 = step_np(g1, LIFE, "periodic")
+    assert (g1 != g).any()
+    np.testing.assert_array_equal(g2, g)
+
+
+def test_block_still_life():
+    g = _place([[1, 1], [1, 1]])
+    np.testing.assert_array_equal(step_np(g, LIFE, "periodic"), g)
+
+
+def test_glider_translates():
+    glider = [[0, 1, 0], [0, 0, 1], [1, 1, 1]]
+    g = _place(glider, size=20, at=(3, 3))
+    g4 = evolve_np(g, 4, LIFE, "periodic")
+    np.testing.assert_array_equal(g4, np.roll(np.roll(g, 1, 0), 1, 1))
+
+
+def test_boundary_matters_at_edge():
+    # A blinker touching the top edge behaves differently under wrap vs dead.
+    g = np.zeros((8, 8), dtype=np.uint8)
+    g[0, 2:5] = 1
+    periodic = step_np(g, LIFE, "periodic")
+    dead = step_np(g, LIFE, "dead")
+    assert (periodic != dead).any()
+
+
+def test_seeds_no_survival():
+    g = _place([[1, 1], [1, 1]])
+    out = step_np(g, SEEDS, "periodic")
+    # every live cell dies under Seeds (B2/S-)
+    assert (out[g.astype(bool)] == 0).all()
+
+
+def test_highlife_differs_from_life():
+    rng = np.random.default_rng(0)
+    g = (rng.random((32, 32)) < 0.5).astype(np.uint8)
+    assert (evolve_np(g, 8, LIFE) != evolve_np(g, 8, HIGHLIFE)).any()
+
+
+def test_bosco_radius5_runs():
+    rng = np.random.default_rng(1)
+    g = (rng.random((48, 48)) < 0.33).astype(np.uint8)
+    out = evolve_np(g, 3, BOSCO, "periodic")
+    assert out.shape == g.shape
+    assert out.dtype == np.uint8
+
+
+def test_radius_capped_at_7():
+    Rule("r7", frozenset({100}), frozenset(), radius=7)  # max count 224 fits uint8
+    with pytest.raises(ValueError):
+        Rule("r8", frozenset({100}), frozenset(), radius=8)

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Flagship benchmark — one JSON line for the driver.
+
+Metric: cell-updates/sec for Conway's Life (periodic) on one chip,
+16384² grid — the reference's derived throughput metric
+(cells/sec = gszI·gszJ·nIter / t_nosetup, /root/reference/main.cpp:337-347)
+measured the XLA way: the whole multi-step evolution is one compiled scan,
+with a scalar population reduction as output so timing excludes host
+transfer of the grid (the device↔host tunnel is slow and would otherwise
+dominate; block_until_ready alone under-reports on this platform).
+
+vs_baseline: ratio to the north star's per-chip share — BASELINE.json
+targets >= 1e11 cells/s on v5e-64, i.e. 1.5625e9 per chip.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+SIZE = 16384
+STEPS = 200
+BASELINE_PER_CHIP = 1e11 / 64
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_tpu.models.rules import LIFE
+    from mpi_tpu.ops.stencil import step
+    from mpi_tpu.utils.hashinit import init_tile_jnp
+
+    @functools.partial(jax.jit, static_argnames=("steps",))
+    def evolve_pop(g, steps):
+        out, _ = lax.scan(
+            lambda x, _: (step(x, LIFE, "periodic"), None), g, None, length=steps
+        )
+        return jnp.sum(out.astype(jnp.uint32))
+
+    grid = init_tile_jnp(SIZE, SIZE, seed=1)
+    int(np.asarray(evolve_pop(grid, STEPS)))  # compile + warm ("setup")
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(np.asarray(evolve_pop(grid, STEPS)))
+        dt = time.perf_counter() - t0
+        best = max(best, SIZE * SIZE * STEPS / dt)
+    print(
+        json.dumps(
+            {
+                "metric": "cell_updates_per_sec_single_chip",
+                "value": round(best, 1),
+                "unit": "cells/s",
+                "vs_baseline": round(best / BASELINE_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

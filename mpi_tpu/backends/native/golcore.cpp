@@ -1,0 +1,345 @@
+// golcore — native C++ engine for mpi_tpu.
+//
+// The reference implements its native layer with MPI (main.cpp) and a serial
+// C++ oracle (main_serial.cpp).  This is the framework's equivalent, built
+// from scratch:
+//
+//   * gol_init            — the decomposition-invariant hash init, bit-identical
+//                           to utils/hashinit.py (replaces srand(rank)/srand(seed),
+//                           reference main.cpp:70 / main_serial.cpp:36).
+//   * gol_step/gol_evolve — serial engine: separable window-sum neighbor counts
+//                           + rule-table apply, double buffered (the corrected,
+//                           generalized form of main_serial.cpp:45-71; boundary
+//                           is a flag instead of hardcoded periodic).
+//   * gol_evolve_par      — multi-worker engine: 2D tile decomposition over a
+//                           worker mesh, each tile owning a radius-wide ghost
+//                           ring filled by an explicit 8-neighbor halo exchange
+//                           with barrier phases — the shared-memory analog of
+//                           the reference's MPI_Isend/Irecv distr_borders
+//                           (main.cpp:36-65), with the halo pairing bug fixed
+//                           (ghosts hold the geometrically adjacent neighbor's
+//                           edge, SURVEY.md §5.8 quirk #1).
+//
+// Exposed via a C ABI for the ctypes wrapper in backends/cpp.py.
+
+#include <cstdint>
+#include <cstring>
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Hash init — must match utils/hashinit.py exactly (pinned by tests).
+// murmur3 32-bit finalizer; keys folded in with odd multiplicative constants.
+// ---------------------------------------------------------------------------
+
+inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
+inline uint32_t cell_hash(uint32_t seed, uint32_t i, uint32_t j) {
+    uint32_t hi = fmix32(seed ^ (i * 0x9E3779B1u));
+    return fmix32(hi ^ (j * 0x85EBCA77u));
+}
+
+// ---------------------------------------------------------------------------
+// Stencil on a padded tile.
+//
+// buf: (rows + 2r) x (cols + 2r), row-major, ghost ring included.
+// Separable counts: vertical window sum into a rowsum scratch (kept at full
+// padded width so the horizontal pass sees shifted columns), then horizontal
+// window sum minus the center — same algorithm as ops/stencil.py, O(2r+1)
+// adds per cell per axis instead of (2r+1)^2 gathers.
+// ---------------------------------------------------------------------------
+
+struct RuleTables {
+    const uint8_t* birth;    // indexed by neighbor count
+    const uint8_t* survive;
+    int radius;
+};
+
+void step_padded(const uint8_t* in, uint8_t* out, int64_t rows, int64_t cols,
+                 const RuleTables& rule, uint8_t* rowsum /* rows x (cols+2r) */) {
+    const int r = rule.radius;
+    const int win = 2 * r + 1;
+    const int64_t pw = cols + 2 * r;  // padded width
+    for (int64_t i = 0; i < rows; ++i) {
+        const uint8_t* base = in + i * pw;
+        uint8_t* rs = rowsum + i * pw;
+        for (int64_t j = 0; j < pw; ++j) rs[j] = base[j];
+        for (int k = 1; k < win; ++k) {
+            const uint8_t* row = in + (i + k) * pw;
+            for (int64_t j = 0; j < pw; ++j) rs[j] += row[j];
+        }
+    }
+    for (int64_t i = 0; i < rows; ++i) {
+        const uint8_t* rs = rowsum + i * pw;
+        const uint8_t* center_row = in + (i + r) * pw + r;
+        uint8_t* dst = out + (i + r) * pw + r;
+        for (int64_t j = 0; j < cols; ++j) {
+            uint8_t c = rs[j];
+            for (int k = 1; k < win; ++k) c += rs[j + k];
+            c -= center_row[j];
+            dst[j] = center_row[j] ? rule.survive[c] : rule.birth[c];
+        }
+    }
+}
+
+// Fill the ghost ring of a standalone padded buffer from its own interior
+// (periodic) or zeros (dead).  Used by the serial engine.
+void fill_ghosts_self(uint8_t* buf, int64_t rows, int64_t cols, int r, bool periodic) {
+    const int64_t pw = cols + 2 * r;
+    const int64_t ph = rows + 2 * r;
+    if (!periodic) {
+        for (int64_t i = 0; i < ph; ++i) {
+            uint8_t* row = buf + i * pw;
+            if (i < r || i >= rows + r) {
+                std::memset(row, 0, pw);
+            } else {
+                std::memset(row, 0, r);
+                std::memset(row + cols + r, 0, r);
+            }
+        }
+        return;
+    }
+    // periodic: wrap rows then columns (row pass first so column wrap copies
+    // the already-wrapped rows — corners come out right).
+    for (int k = 0; k < r; ++k) {
+        std::memcpy(buf + k * pw + r, buf + (rows + k) * pw + r, cols);
+        std::memcpy(buf + (rows + r + k) * pw + r, buf + (r + k) * pw + r, cols);
+    }
+    for (int64_t i = 0; i < ph; ++i) {
+        uint8_t* row = buf + i * pw;
+        for (int k = 0; k < r; ++k) {
+            row[k] = row[cols + k];
+            row[cols + r + k] = row[r + k];
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Reusable spinning-free barrier (C++17; std::barrier is C++20).
+// ---------------------------------------------------------------------------
+
+class Barrier {
+  public:
+    explicit Barrier(int n) : n_(n), waiting_(0), phase_(0) {}
+    void arrive_and_wait() {
+        std::unique_lock<std::mutex> lk(m_);
+        int phase = phase_;
+        if (++waiting_ == n_) {
+            waiting_ = 0;
+            ++phase_;
+            cv_.notify_all();
+        } else {
+            cv_.wait(lk, [&] { return phase_ != phase; });
+        }
+    }
+
+  private:
+    int n_, waiting_, phase_;
+    std::mutex m_;
+    std::condition_variable cv_;
+};
+
+// ---------------------------------------------------------------------------
+// Parallel engine: tile mesh + ghost-ring halo exchange.
+// ---------------------------------------------------------------------------
+
+struct Tile {
+    int64_t r0, c0, rows, cols;  // interior placement in the global grid
+    std::vector<uint8_t> a, b;   // double-buffered padded storage
+    std::vector<uint8_t> rowsum;
+};
+
+struct ParEngine {
+    int ti, tj, radius;
+    bool periodic;
+    std::vector<Tile> tiles;
+
+    Tile& at(int i, int j) { return tiles[(size_t)i * tj + j]; }
+
+    // Neighbor tile index along one axis, honoring boundary; -1 = none (dead).
+    int wrap(int x, int n) const {
+        if (x >= 0 && x < n) return x;
+        return periodic ? (x + n) % n : -1;
+    }
+};
+
+// Copy a rect from src tile's CURRENT interior into dst tile's padded buffer.
+// Coordinates are interior-relative (0-based); dst offsets are padded-buffer
+// absolute.  cur selects which double buffer is "current" this step.
+inline void copy_rect(const Tile& src, const std::vector<uint8_t>& src_buf, int r,
+                      int64_t si, int64_t sj, Tile& dst, std::vector<uint8_t>& dst_buf,
+                      int64_t di, int64_t dj, int64_t h, int64_t w) {
+    const int64_t spw = src.cols + 2 * r;
+    const int64_t dpw = dst.cols + 2 * r;
+    for (int64_t k = 0; k < h; ++k) {
+        std::memcpy(dst_buf.data() + (di + k) * dpw + dj,
+                    src_buf.data() + (si + r + k) * spw + sj + r, w);
+    }
+}
+
+// Fill every ghost slab of tile (i, j) from its 8 mesh neighbors' interiors —
+// the shared-memory distr_borders.  Reads neighbors' current buffers (stable
+// during the exchange phase; a barrier separates exchange from compute).
+void exchange_tile(ParEngine& e, int i, int j, bool cur_is_a) {
+    Tile& t = e.at(i, j);
+    std::vector<uint8_t>& dst = cur_is_a ? t.a : t.b;
+    const int r = e.radius;
+    const int64_t pw = t.cols + 2 * r;
+
+    for (int di = -1; di <= 1; ++di) {
+        for (int dj = -1; dj <= 1; ++dj) {
+            if (di == 0 && dj == 0) continue;
+            // Destination slab in t's padded buffer.
+            int64_t dst_i = di < 0 ? 0 : (di == 0 ? r : t.rows + r);
+            int64_t dst_j = dj < 0 ? 0 : (dj == 0 ? r : t.cols + r);
+            int64_t h = di == 0 ? t.rows : r;
+            int64_t w = dj == 0 ? t.cols : r;
+            int ni = e.wrap(i + di, e.ti);
+            int nj = e.wrap(j + dj, e.tj);
+            if (ni < 0 || nj < 0) {
+                for (int64_t k = 0; k < h; ++k)
+                    std::memset(dst.data() + (dst_i + k) * pw + dst_j, 0, w);
+                continue;
+            }
+            Tile& s = e.at(ni, nj);
+            const std::vector<uint8_t>& src = cur_is_a ? s.a : s.b;
+            // Source rect: the neighbor's interior edge facing us.
+            int64_t si = di < 0 ? s.rows - r : 0;  // coming from above: its bottom
+            int64_t sj = dj < 0 ? s.cols - r : 0;
+            copy_rect(s, src, r, si, sj, t, dst, dst_i, dst_j, h, w);
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fill a (rows x cols) uint8 tile of the global grid starting at
+// (row_off, col_off); alive iff hash % 3 == 0 (P = 1/3, matching the
+// reference's rand() % 3 == 0 density, main.cpp:69-73).
+void gol_init(uint8_t* grid, int64_t rows, int64_t cols, uint32_t seed,
+              int64_t row_off, int64_t col_off) {
+    for (int64_t i = 0; i < rows; ++i) {
+        uint32_t gi = (uint32_t)(row_off + i);
+        for (int64_t j = 0; j < cols; ++j) {
+            uint32_t gj = (uint32_t)(col_off + j);
+            grid[i * cols + j] = cell_hash(seed, gi, gj) % 3u == 0u;
+        }
+    }
+}
+
+// One serial step: in/out are UNPADDED (rows x cols) buffers.
+void gol_step(const uint8_t* in, uint8_t* out, int64_t rows, int64_t cols,
+              const uint8_t* birth_table, const uint8_t* survive_table,
+              int radius, int periodic) {
+    const int r = radius;
+    const int64_t pw = cols + 2 * r, ph = rows + 2 * r;
+    std::vector<uint8_t> pin((size_t)(ph * pw)), pout((size_t)(ph * pw));
+    std::vector<uint8_t> rowsum((size_t)(rows * pw));
+    for (int64_t i = 0; i < rows; ++i)
+        std::memcpy(pin.data() + (i + r) * pw + r, in + i * cols, cols);
+    fill_ghosts_self(pin.data(), rows, cols, r, periodic != 0);
+    RuleTables rule{birth_table, survive_table, r};
+    step_padded(pin.data(), pout.data(), rows, cols, rule, rowsum.data());
+    for (int64_t i = 0; i < rows; ++i)
+        std::memcpy(out + i * cols, pout.data() + (i + r) * pw + r, cols);
+}
+
+// Serial evolution, double buffered in padded space; result lands in grid.
+void gol_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
+                const uint8_t* birth_table, const uint8_t* survive_table,
+                int radius, int periodic) {
+    const int r = radius;
+    const int64_t pw = cols + 2 * r, ph = rows + 2 * r;
+    std::vector<uint8_t> a((size_t)(ph * pw)), b((size_t)(ph * pw));
+    std::vector<uint8_t> rowsum((size_t)(rows * pw));
+    for (int64_t i = 0; i < rows; ++i)
+        std::memcpy(a.data() + (i + r) * pw + r, grid + i * cols, cols);
+    RuleTables rule{birth_table, survive_table, r};
+    uint8_t *cur = a.data(), *nxt = b.data();
+    for (int64_t s = 0; s < steps; ++s) {
+        fill_ghosts_self(cur, rows, cols, r, periodic != 0);
+        step_padded(cur, nxt, rows, cols, rule, rowsum.data());
+        std::swap(cur, nxt);
+    }
+    for (int64_t i = 0; i < rows; ++i)
+        std::memcpy(grid + i * cols, cur + (i + r) * pw + r, cols);
+}
+
+// Parallel evolution over a ti x tj worker-tile mesh (one thread per tile).
+// Requires rows % ti == 0 and cols % tj == 0; returns 0 on success.
+int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
+                   const uint8_t* birth_table, const uint8_t* survive_table,
+                   int radius, int periodic, int ti, int tj) {
+    if (ti < 1 || tj < 1 || rows % ti || cols % tj) return 1;
+    const int r = radius;
+    const int64_t trows = rows / ti, tcols = cols / tj;
+    if (trows < r || tcols < r) return 2;  // ghost slab must fit in one neighbor
+
+    ParEngine e;
+    e.ti = ti; e.tj = tj; e.radius = r; e.periodic = periodic != 0;
+    e.tiles.resize((size_t)ti * tj);
+    const int64_t pw = tcols + 2 * r, ph = trows + 2 * r;
+    for (int i = 0; i < ti; ++i) {
+        for (int j = 0; j < tj; ++j) {
+            Tile& t = e.at(i, j);
+            t.r0 = i * trows; t.c0 = j * tcols; t.rows = trows; t.cols = tcols;
+            t.a.assign((size_t)(ph * pw), 0);
+            t.b.assign((size_t)(ph * pw), 0);
+            t.rowsum.assign((size_t)(trows * pw), 0);
+            for (int64_t k = 0; k < trows; ++k)
+                std::memcpy(t.a.data() + (k + r) * pw + r,
+                            grid + (t.r0 + k) * cols + t.c0, tcols);
+        }
+    }
+
+    Barrier barrier(ti * tj);
+    std::vector<std::thread> workers;
+    workers.reserve((size_t)ti * tj);
+    for (int i = 0; i < ti; ++i) {
+        for (int j = 0; j < tj; ++j) {
+            workers.emplace_back([&e, &barrier, i, j, steps, birth_table,
+                                  survive_table]() {
+                Tile& t = e.at(i, j);
+                RuleTables rule{birth_table, survive_table, e.radius};
+                bool cur_is_a = true;
+                for (int64_t s = 0; s < steps; ++s) {
+                    exchange_tile(e, i, j, cur_is_a);
+                    barrier.arrive_and_wait();  // all ghosts filled
+                    uint8_t* cur = cur_is_a ? t.a.data() : t.b.data();
+                    uint8_t* nxt = cur_is_a ? t.b.data() : t.a.data();
+                    step_padded(cur, nxt, t.rows, t.cols, rule, t.rowsum.data());
+                    cur_is_a = !cur_is_a;
+                    barrier.arrive_and_wait();  // all interiors written
+                }
+            });
+        }
+    }
+    for (auto& w : workers) w.join();
+
+    const bool final_is_a = (steps % 2) == 0;
+    for (int i = 0; i < ti; ++i) {
+        for (int j = 0; j < tj; ++j) {
+            Tile& t = e.at(i, j);
+            const uint8_t* buf = final_is_a ? t.a.data() : t.b.data();
+            for (int64_t k = 0; k < trows; ++k)
+                std::memcpy(grid + (t.r0 + k) * cols + t.c0,
+                            buf + (k + r) * pw + r, tcols);
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,244 @@
+"""Command-line front end — the reference's CLI contract
+(``rows cols iteration_gap iterations [time_file] [first]``,
+``/root/reference/main.cpp:171-223``) with the ``--backend`` switch the
+north star asks for, plus flags for everything the reference hardcoded.
+
+Examples::
+
+    python -m mpi_tpu.cli 1024 1024 100 1000 --backend tpu
+    python -m mpi_tpu.cli 64 64 10 50 --backend serial --save --out-dir /tmp/run
+    python -m mpi_tpu.cli 64 64 10 50 --backend cpp-par --workers 8 --save
+    python -m mpi_tpu.cli 64 64 10 100 --resume 2026-01-01-00-00-00@50
+
+Every backend produces bit-identical grids and the same ``.gol`` dump
+format, so ``tools/gol_visualization.py`` works on any run, and
+``<time_file>_compact.csv`` keeps the reference's 12-column schema for
+sweep tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time as _time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from mpi_tpu import golio
+from mpi_tpu.config import ConfigError, GolConfig, plan_segments
+from mpi_tpu.models.rules import rule_from_name
+from mpi_tpu.utils.timing import PhaseTimer, write_reports
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_tpu",
+        description="TPU-native Game-of-Life / stencil engine "
+        "(serial, native C++, and TPU backends).",
+    )
+    p.add_argument("rows", type=int)
+    p.add_argument("cols", type=int)
+    p.add_argument("iteration_gap", type=int,
+                   help="iterations between snapshots (reference: file_jump)")
+    p.add_argument("iterations", type=int)
+    p.add_argument("time_file", nargs="?", default=None,
+                   help="basename for timing reports (default: run name)")
+    p.add_argument("first", nargs="?", type=int, default=0,
+                   help="nonzero: write the CSV header (sweep convention)")
+    p.add_argument("--backend", choices=["tpu", "serial", "cpp", "cpp-par"],
+                   default="tpu")
+    p.add_argument("--boundary", choices=["periodic", "dead"], default="periodic")
+    p.add_argument("--rule", default="life",
+                   help="life|highlife|seeds|daynight|bosco or B3/S23 / "
+                   "R5,B34-45,S33-57 syntax")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--save", action="store_true",
+                   help="write .gol snapshots every iteration_gap steps")
+    p.add_argument("--out-dir", default=".")
+    p.add_argument("--mesh", default=None, metavar="IxJ",
+                   help="TPU device mesh shape, e.g. 2x4 (default: auto)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="cpp-par worker threads (default: auto)")
+    p.add_argument("--name", default=None, help="run name (default: timestamp)")
+    p.add_argument("--strict", action="store_true",
+                   help="enforce the reference's validation rules "
+                   "(square grid, square mesh, tile >= 4)")
+    p.add_argument("--resume", default=None, metavar="NAME@ITER",
+                   help="resume from snapshot ITER of run NAME; 'iterations' "
+                   "then counts additional steps")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def _parse_mesh(s: Optional[str]) -> Optional[Tuple[int, int]]:
+    if s is None:
+        return None
+    try:
+        a, b = s.lower().split("x")
+        return int(a), int(b)
+    except ValueError:
+        raise ConfigError(f"--mesh must look like 2x4, got {s!r}")
+
+
+def _log(quiet: bool, msg: str) -> None:
+    # per-phase liveness lines, the role of the reference's per-rank cout
+    # checkpoints (main.cpp:263,279,281,366)
+    if not quiet:
+        print(f"[mpi_tpu] {msg}", flush=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return _run(args)
+    except (ConfigError, ValueError) as e:
+        # fail fast on all hosts — the MPI_Abort analog (rule-string parse
+        # errors surface as ValueError from rule_from_name)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+def _run(args) -> int:
+    rule = rule_from_name(args.rule)
+    mesh_shape = _parse_mesh(args.mesh)
+    config = GolConfig(
+        rows=args.rows,
+        cols=args.cols,
+        steps=args.iterations,
+        snapshot_every=args.iteration_gap if args.save else 0,
+        seed=args.seed,
+        rule=rule,
+        boundary=args.boundary,
+        backend=args.backend,
+        mesh_shape=mesh_shape,
+        out_dir=args.out_dir,
+        workers=args.workers,
+    )
+    if args.strict:
+        config.validate_strict()
+
+    name = args.name or _time.strftime("%Y-%m-%d-%H-%M-%S")
+    timer = PhaseTimer()
+
+    initial = None
+    start_iter = 0
+    if args.resume:
+        try:
+            rname, riter = args.resume.rsplit("@", 1)
+            start_iter = int(riter)
+        except ValueError:
+            raise ConfigError(f"--resume must look like NAME@ITER, got {args.resume!r}")
+        try:
+            initial = golio.load_snapshot(args.out_dir, rname, start_iter)
+        except FileNotFoundError as e:
+            raise ConfigError(f"cannot resume {args.resume!r}: {e}")
+        if initial.shape != (config.rows, config.cols):
+            raise ConfigError(
+                f"snapshot {rname}@{start_iter} is {initial.shape}, "
+                f"run asks for {(config.rows, config.cols)}"
+            )
+        name = args.name or rname
+        _log(args.quiet, f"resumed {rname}@{start_iter}")
+
+    total_iter = start_iter + config.steps
+
+    # processes in the master header = number of tile writers
+    if config.backend in ("serial", "cpp"):
+        processes = 1
+        tiles_shape = (1, 1)
+    elif config.backend == "cpp-par":
+        from mpi_tpu.backends.cpp import plan_tiles
+
+        tiles_shape = plan_tiles((config.rows, config.cols), config.workers, rule.radius)
+        processes = tiles_shape[0] * tiles_shape[1]
+    else:
+        from mpi_tpu.backends.tpu import device_count
+
+        processes = device_count() if mesh_shape is None else mesh_shape[0] * mesh_shape[1]
+
+    golio.write_master(
+        args.out_dir, name, config.rows, config.cols,
+        args.iteration_gap, total_iter, processes,
+    )
+    _log(args.quiet, f"run {name}: {config.rows}x{config.cols} x{config.steps} steps, "
+         f"rule={rule}, boundary={config.boundary}, backend={config.backend}, "
+         f"processes={processes}")
+
+    def host_snapshot(grid: np.ndarray, iteration: int, tiles_shape) -> None:
+        ti, tj = tiles_shape
+        tr, tc = grid.shape[0] // ti, grid.shape[1] // tj
+        tiles = [
+            (grid[i * tr : (i + 1) * tr, j * tc : (j + 1) * tc], i * tr, j * tc)
+            for i in range(ti)
+            for j in range(tj)
+        ]
+        golio.write_snapshot_tiles(args.out_dir, name, iteration, tiles)
+
+    if config.backend == "tpu":
+        from mpi_tpu.backends.tpu import run_tpu
+
+        def cb(iteration, tiles):
+            golio.write_snapshot_tiles(args.out_dir, name, iteration, tiles)
+
+        final = run_tpu(
+            config,
+            timer=timer,
+            snapshot_cb=cb if args.save else None,
+            initial=initial,
+            start_iteration=start_iter,
+        )
+    else:
+        if config.backend == "serial":
+            from mpi_tpu.backends.serial_np import evolve_np as _evolve
+
+            def engine(g, n):
+                return _evolve(g, n, rule, config.boundary)
+        elif config.backend == "cpp":
+            from mpi_tpu.backends.cpp import evolve_cpp
+
+            def engine(g, n):
+                return evolve_cpp(g, n, rule, config.boundary)
+        else:  # cpp-par
+            from mpi_tpu.backends.cpp import evolve_par_cpp
+
+            def engine(g, n):
+                return evolve_par_cpp(g, n, rule, config.boundary, tiles=tiles_shape)
+
+        if config.backend in ("cpp", "cpp-par"):
+            # building/loading the native library is setup, like XLA compile
+            from mpi_tpu.backends.cpp import load_library
+
+            load_library()
+        if initial is None:
+            from mpi_tpu.utils.hashinit import init_tile_np
+
+            grid = init_tile_np(config.rows, config.cols, config.seed)
+        else:
+            grid = initial
+        timer.setup_done()
+        it = start_iter
+        if args.save and it == 0:
+            host_snapshot(grid, 0, tiles_shape)
+        for n in plan_segments(config.steps, args.iteration_gap if args.save else 0):
+            grid = engine(grid, n)
+            it += n
+            if args.save:
+                host_snapshot(grid, it, tiles_shape)
+        timer.finish()
+        final = grid
+
+    time_file = args.time_file or name
+    write_reports(
+        time_file, timer, config.rows, config.cols, processes,
+        first=bool(args.first), out_dir=args.out_dir,
+    )
+    cps = timer.cells_per_sec(config.rows, config.cols, config.steps)
+    _log(args.quiet,
+         f"done: setup {timer.setup_us / 1e6:.2f}s, steady {timer.nosetup_us / 1e6:.2f}s, "
+         f"{cps / 1e9:.3f} G cell-updates/s; population {int(final.sum())}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

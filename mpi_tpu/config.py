@@ -20,17 +20,35 @@ derives the identical config (the TPU-native answer to the reference's
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
-from mpi_tpu.models.rules import Rule, LIFE, rule_from_name
+from mpi_tpu.models.rules import Rule, LIFE
 
 
 class ConfigError(ValueError):
     """Invalid run configuration (the fail-fast analog of the reference's
     ``MPI_Abort`` on bad args, ``main.cpp:176,189,197``)."""
+
+
+def validate_mesh(rows: int, cols: int, mesh_shape: Tuple[int, int], radius: int) -> None:
+    """Grid/mesh compatibility: divisibility and minimum tile size.  Called
+    both for explicit ``--mesh`` shapes and for auto-chosen device meshes
+    (the TPU runner validates after choosing), so every path fails fast with
+    a named error instead of a deep shard_map trace error."""
+    mi, mj = mesh_shape
+    if mi < 1 or mj < 1:
+        raise ConfigError(f"mesh_shape must be positive, got {mesh_shape}")
+    if rows % mi or cols % mj:
+        raise ConfigError(f"mesh {mesh_shape} does not divide grid {rows}x{cols}")
+    tile_r, tile_c = rows // mi, cols // mj
+    min_tile = 2 * radius + 2
+    if (mi > 1 and tile_r < min_tile) or (mj > 1 and tile_c < min_tile):
+        raise ConfigError(
+            f"tile {tile_r}x{tile_c} too small for radius {radius} "
+            f"halo (need >= {min_tile} per sharded axis)"
+        )
 
 
 @dataclass(frozen=True)
@@ -44,7 +62,6 @@ class GolConfig:
     boundary: str = "periodic"       # "periodic" | "dead"
     backend: str = "tpu"             # "tpu" | "serial" | "cpp" | "cpp-par"
     mesh_shape: Optional[Tuple[int, int]] = None  # device mesh (rows_axis, cols_axis); None = auto
-    program_name: str = ""           # master .gol name; "" = timestamp at run time
     out_dir: str = "."
     workers: int = 0                 # native backend threads; 0 = auto
 
@@ -62,20 +79,7 @@ class GolConfig:
                 f"backend must be one of tpu/serial/cpp/cpp-par, got {self.backend!r}"
             )
         if self.mesh_shape is not None:
-            mi, mj = self.mesh_shape
-            if mi < 1 or mj < 1:
-                raise ConfigError(f"mesh_shape must be positive, got {self.mesh_shape}")
-            if self.rows % mi or self.cols % mj:
-                raise ConfigError(
-                    f"mesh {self.mesh_shape} does not divide grid {self.rows}x{self.cols}"
-                )
-            tile_r, tile_c = self.rows // mi, self.cols // mj
-            min_tile = 2 * self.rule.radius + 2
-            if (mi > 1 and tile_r < min_tile) or (mj > 1 and tile_c < min_tile):
-                raise ConfigError(
-                    f"tile {tile_r}x{tile_c} too small for radius {self.rule.radius} "
-                    f"halo (need >= {min_tile} per sharded axis)"
-                )
+            validate_mesh(self.rows, self.cols, self.mesh_shape, self.rule.radius)
 
     def validate_strict(self) -> None:
         """Enforce the reference's exact preconditions (``main.cpp:195``):
@@ -93,30 +97,15 @@ class GolConfig:
             if self.rows // mi < 4:
                 raise ConfigError("strict mode: tile must be >= 4 cells per side")
 
-    def with_(self, **kw) -> "GolConfig":
-        return dataclasses.replace(self, **kw)
-
     @property
     def cells(self) -> int:
         return self.rows * self.cols
 
-    @staticmethod
-    def from_cli_args(
-        rows: int,
-        cols: int,
-        iteration_gap: int,
-        iterations: int,
-        *,
-        rule: str = "life",
-        **kw,
-    ) -> "GolConfig":
-        """Build from the reference's positional contract
-        ``rows cols iteration_gap iterations`` (``main.cpp:171-199``)."""
-        return GolConfig(
-            rows=rows,
-            cols=cols,
-            steps=iterations,
-            snapshot_every=iteration_gap,
-            rule=rule_from_name(rule) if isinstance(rule, str) else rule,
-            **kw,
-        )
+
+def plan_segments(steps: int, snapshot_every: int) -> List[int]:
+    """Split `steps` into evolution-segment lengths between snapshot points
+    (shared by every backend so their snapshot series always align)."""
+    if snapshot_every <= 0 or snapshot_every >= steps:
+        return [steps] if steps else []
+    full, rem = divmod(steps, snapshot_every)
+    return [snapshot_every] * full + ([rem] if rem else [])

@@ -1,0 +1,155 @@
+""".gol snapshot file format — the cross-backend contract.
+
+Format (wire-compatible with the reference so one visualizer serves every
+backend; defined by ``/root/reference/main_serial.cpp:74-113`` and consumed
+by ``/root/reference/gol_visualization.py``):
+
+* master file ``<name>.gol``: one line ``rows cols iteration_gap iterations
+  processes``;
+* per-tile files ``<name>_<iteration>_<pid>.gol``: two metadata lines
+  ``firstRow lastRow`` / ``firstCol lastCol`` (inclusive global coordinates),
+  then the tile interior as tab-separated 0/1 rows (trailing tab per row,
+  exactly as the reference's ``ostream_iterator`` emits).
+
+Improvements over the reference (SURVEY.md §5.4): snapshots are portable
+(no hardcoded cluster path, ``main.cpp:110``), actually enabled (the
+reference pins ``save_file=0``, ``main.cpp:208``), and **readable back** —
+the reference has no resume path; ``load_snapshot`` makes
+checkpoint/restart real.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Tuple
+
+import numpy as np
+
+
+def master_path(out_dir: str, name: str) -> str:
+    return os.path.join(out_dir, f"{name}.gol")
+
+
+def tile_path(out_dir: str, name: str, iteration: int, pid: int) -> str:
+    return os.path.join(out_dir, f"{name}_{iteration}_{pid}.gol")
+
+
+def write_master(
+    out_dir: str, name: str, rows: int, cols: int,
+    iteration_gap: int, iterations: int, processes: int,
+) -> str:
+    """The manifest the visualizer reads (reference ``setUpProgram``,
+    ``main_serial.cpp:97-113``)."""
+    path = master_path(out_dir, name)
+    with open(path, "w") as f:
+        f.write(f"{rows} {cols} {iteration_gap} {iterations} {processes}\n")
+    return path
+
+
+def read_master(path: str) -> Tuple[int, int, int, int, int]:
+    with open(path) as f:
+        parts = f.readline().split()
+    if len(parts) != 5:
+        raise ValueError(f"malformed master .gol header in {path!r}: {parts}")
+    rows, cols, gap, iters, procs = map(int, parts)
+    return rows, cols, gap, iters, procs
+
+
+def write_tile(
+    out_dir: str, name: str, iteration: int, pid: int,
+    tile: np.ndarray, first_row: int, first_col: int,
+) -> str:
+    rows, cols = tile.shape
+    path = tile_path(out_dir, name, iteration, pid)
+    with open(path, "w") as f:
+        f.write(f"{first_row} {first_row + rows - 1}\n")
+        f.write(f"{first_col} {first_col + cols - 1}\n")
+        for r in tile:
+            # trailing tab matches the reference's ostream_iterator output
+            f.write("\t".join("1" if v else "0" for v in r) + "\t\n")
+    return path
+
+
+def read_tile(path: str) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    with open(path) as f:
+        r0, r1 = map(int, f.readline().split())
+        c0, c1 = map(int, f.readline().split())
+        data = [line.split() for line in f if line.strip()]
+    tile = np.array(data, dtype=np.uint8)
+    expect = (r1 - r0 + 1, c1 - c0 + 1)
+    if tile.shape != expect:
+        raise ValueError(f"{path!r}: tile shape {tile.shape} != metadata {expect}")
+    return tile, (r0, r1, c0, c1)
+
+
+def list_snapshot_iterations(out_dir: str, name: str) -> List[int]:
+    """Iterations for which tile files exist (pid 0 as the witness)."""
+    pat = re.compile(re.escape(name) + r"_(\d+)_0\.gol$")
+    out = []
+    for fn in os.listdir(out_dir or "."):
+        m = pat.match(fn)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def iteration_tile_pids(out_dir: str, name: str, iteration: int) -> List[int]:
+    """pids of the tile files actually present for one iteration."""
+    pat = re.compile(re.escape(name) + "_" + str(iteration) + r"_(\d+)\.gol$")
+    pids = []
+    for fn in os.listdir(out_dir or "."):
+        m = pat.match(fn)
+        if m:
+            pids.append(int(m.group(1)))
+    return sorted(pids)
+
+
+def assemble(out_dir: str, name: str, iteration: int) -> np.ndarray:
+    """Stitch all per-process tiles of one iteration into the global grid
+    (what the reference visualizer does at ``gol_visualization.py:18-34``).
+
+    Tiles are discovered from the files present rather than the master's
+    ``processes`` field: a resumed run may write a different tile count per
+    iteration (e.g. a 4-worker native run resumed on a 1-chip TPU), and the
+    master header can only record one value.
+    """
+    rows, cols, _, _, _ = read_master(master_path(out_dir, name))
+    pids = iteration_tile_pids(out_dir, name, iteration)
+    if not pids:
+        raise ValueError(f"snapshot {name}@{iteration}: no tile files found")
+    grid = np.zeros((rows, cols), dtype=np.uint8)
+    seen = np.zeros((rows, cols), dtype=bool)
+    for pid in pids:
+        tile, (r0, r1, c0, c1) = read_tile(tile_path(out_dir, name, iteration, pid))
+        grid[r0 : r1 + 1, c0 : c1 + 1] = tile
+        seen[r0 : r1 + 1, c0 : c1 + 1] = True
+    if not seen.all():
+        raise ValueError(
+            f"snapshot {name}@{iteration}: tiles cover only "
+            f"{int(seen.sum())}/{rows * cols} cells"
+        )
+    return grid
+
+
+def load_snapshot(out_dir: str, name: str, iteration: int) -> np.ndarray:
+    """Checkpoint-restart entry: the global grid at a saved iteration."""
+    return assemble(out_dir, name, iteration)
+
+
+def write_snapshot_tiles(
+    out_dir: str, name: str, iteration: int,
+    tiles: List[Tuple[np.ndarray, int, int]],
+) -> None:
+    """Write one iteration's snapshot as per-process tiles.
+    tiles: list of (tile_array, first_row, first_col), pid = list index.
+
+    Stale tiles from a previous run at the same (name, iteration) with a
+    larger writer count are removed — otherwise a resume that rewrites an
+    iteration with fewer writers would leave old tiles behind and
+    ``assemble`` would silently merge two runs' data."""
+    for pid, (tile, r0, c0) in enumerate(tiles):
+        write_tile(out_dir, name, iteration, pid, tile, r0, c0)
+    for pid in iteration_tile_pids(out_dir, name, iteration):
+        if pid >= len(tiles):
+            os.remove(tile_path(out_dir, name, iteration, pid))

@@ -1,0 +1,105 @@
+"""Three-phase timing + report files — schema-compatible with the
+reference's observability channel (SURVEY.md §5.1/§5.5).
+
+The reference brackets ``begin`` / ``check1`` (post-setup) / ``end`` with
+``steady_clock`` and emits two append-mode reports from rank 0
+(``/root/reference/main.cpp:310-365``): a human-readable
+``<name>_detailed.out`` and a 12-column CSV ``<name>_compact.csv``
+(``X,Y,#P,{full,nosetup,setup}×{single,avg,sum}``, microseconds).  Sweep
+scripts pass ``first != 0`` on the first run to emit the CSV header once
+(``run.sh:4-5``).
+
+Kept identical here so existing reference tooling parses our CSVs, with
+two deliberate fixes: durations are *labeled* as microseconds (the
+reference prints µs with an "ms" suffix, quirk #6), and there is no 1 s
+startup sleep polluting setup time (``main.cpp:150``).
+
+On the TPU backend "setup" = mesh construction + XLA compilation (the
+compile cache plays the role the reference's MPI topology setup played);
+"nosetup" = steady-state stepping, which is what throughput is derived
+from: cells/sec = rows·cols·iters / t_nosetup.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+CSV_HEADER = (
+    "X,Y,#P,full single,full avg,full sum,nosetup single,nosetup avg,"
+    "nosetup sum,setup single ,setup avg ,setup sum \n"
+)
+
+
+@dataclass
+class PhaseTimer:
+    """start() → [setup work] → setup_done() → [steady work] → finish()."""
+
+    t_begin: float = field(default_factory=time.perf_counter)
+    t_setup_done: float = 0.0
+    t_end: float = 0.0
+
+    def restart(self) -> None:
+        self.t_begin = time.perf_counter()
+
+    def setup_done(self) -> None:
+        self.t_setup_done = time.perf_counter()
+
+    def finish(self) -> None:
+        self.t_end = time.perf_counter()
+        if self.t_setup_done == 0.0:
+            self.t_setup_done = self.t_begin
+
+    @property
+    def full_us(self) -> int:
+        return int((self.t_end - self.t_begin) * 1e6)
+
+    @property
+    def setup_us(self) -> int:
+        return int((self.t_setup_done - self.t_begin) * 1e6)
+
+    @property
+    def nosetup_us(self) -> int:
+        return int((self.t_end - self.t_setup_done) * 1e6)
+
+    def cells_per_sec(self, rows: int, cols: int, iters: int) -> float:
+        ns = self.nosetup_us
+        return rows * cols * iters / (ns / 1e6) if ns > 0 else 0.0
+
+
+def write_reports(
+    time_file: str,
+    timer: PhaseTimer,
+    rows: int,
+    cols: int,
+    processes: int,
+    first: bool = False,
+    out_dir: str = ".",
+) -> None:
+    """Append the reference-schema pair of reports.  ``processes`` is the
+    device/worker count; per-process durations are taken equal to wall time
+    (single == avg; sum = wall × P), which matches how SPMD devices spend
+    time: all of them are driven for the whole run."""
+    full, nosetup, setup = timer.full_us, timer.nosetup_us, timer.setup_us
+    p = max(processes, 1)
+    detailed = os.path.join(out_dir, f"{time_file}_detailed.out")
+    with open(detailed, "a") as f:
+        f.write("Timing results: microseconds\n")
+        f.write(f"size:{rows} by {cols}\n")
+        f.write(f"{p} Processors\n")
+        for label, single in (("Full (with setup)", full), ("Without setup", nosetup), ("Setup", setup)):
+            f.write(f"{label}\n")
+            f.write(f"Single time (rank 0): {single}us\n")
+            f.write(f"Avg single time: {single}us\n")
+            f.write(f"Summed time: {single * p}us\n")
+        f.write(f"Throughput: {timer.cells_per_sec(rows, cols, 1):.0f} cells/sec/iter-unit\n")
+        f.write("___________________________________________________\n\n")
+    compact = os.path.join(out_dir, f"{time_file}_compact.csv")
+    with open(compact, "a") as f:
+        if first:
+            f.write(CSV_HEADER)
+        f.write(
+            f"{rows},{cols},{p},{full},{full},{full * p},"
+            f"{nosetup},{nosetup},{nosetup * p},{setup},{setup},{setup * p}\n"
+        )

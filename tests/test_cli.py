@@ -1,0 +1,131 @@
+"""End-to-end CLI runs: every backend, same config → bit-identical final
+snapshot (the north star's 'gol_visualization.py consumes bit-identical
+grid dumps from all three'), timing reports in the reference CSV schema,
+and checkpoint-resume equivalence."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpi_tpu import golio
+from mpi_tpu.cli import main
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.utils.hashinit import init_tile_np
+from mpi_tpu.models.rules import LIFE
+
+BACKENDS = ["serial", "cpp", "cpp-par", "tpu"]
+
+
+def run_cli(tmp_path, name, backend, extra=()):
+    rc = main([
+        "32", "32", "8", "16", "--backend", backend, "--save",
+        "--out-dir", str(tmp_path), "--name", name, "--seed", "5",
+        "--quiet", *extra,
+    ])
+    assert rc == 0
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_cli_backend_matches_oracle(tmp_path, backend):
+    run_cli(tmp_path, f"run-{backend}", backend)
+    final = golio.assemble(str(tmp_path), f"run-{backend}", 16)
+    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_cli_backends_bit_identical(tmp_path):
+    for b in BACKENDS:
+        run_cli(tmp_path, f"x-{b}", b)
+    grids = [golio.assemble(str(tmp_path), f"x-{b}", 16) for b in BACKENDS]
+    for g in grids[1:]:
+        np.testing.assert_array_equal(g, grids[0])
+
+
+def test_cli_snapshot_series(tmp_path):
+    run_cli(tmp_path, "series", "serial")
+    assert golio.list_snapshot_iterations(str(tmp_path), "series") == [0, 8, 16]
+
+
+def test_cli_timing_reports(tmp_path):
+    rc = main([
+        "32", "32", "8", "16", "t", "1", "--backend", "serial",
+        "--out-dir", str(tmp_path), "--name", "timed", "--quiet",
+    ])
+    assert rc == 0
+    csv = os.path.join(str(tmp_path), "t_compact.csv")
+    with open(csv) as f:
+        header, row = f.read().strip().split("\n")
+    assert header.startswith("X,Y,#P,full single")
+    cells = row.split(",")
+    assert len(cells) == 12
+    assert cells[:3] == ["32", "32", "1"]
+    assert os.path.exists(os.path.join(str(tmp_path), "t_detailed.out"))
+
+
+def test_cli_csv_header_only_when_first(tmp_path):
+    main(["16", "16", "4", "4", "t2", "--backend", "serial",
+          "--out-dir", str(tmp_path), "--name", "a", "--quiet"])
+    with open(os.path.join(str(tmp_path), "t2_compact.csv")) as f:
+        assert not f.read().startswith("X,Y")
+
+
+def test_cli_resume_equivalence(tmp_path):
+    # full run to 16  ==  run to 8, then resume 8 -> 16
+    run_cli(tmp_path, "full", "serial")
+    rc = main(["32", "32", "8", "8", "--backend", "serial", "--save",
+               "--out-dir", str(tmp_path), "--name", "half", "--seed", "5", "--quiet"])
+    assert rc == 0
+    rc = main(["32", "32", "8", "8", "--backend", "cpp", "--save",
+               "--out-dir", str(tmp_path), "--resume", "half@8", "--quiet"])
+    assert rc == 0
+    np.testing.assert_array_equal(
+        golio.assemble(str(tmp_path), "half", 16),
+        golio.assemble(str(tmp_path), "full", 16),
+    )
+
+
+def test_cli_rejects_bad_config(tmp_path):
+    rc = main(["32", "32", "8", "16", "--backend", "serial", "--rule", "nope",
+               "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2
+    rc = main(["0", "32", "8", "16", "--backend", "serial",
+               "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2
+
+
+def test_cli_strict_rejects_nonsquare(tmp_path):
+    rc = main(["32", "16", "8", "4", "--backend", "serial", "--strict",
+               "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2
+
+
+def test_cli_tpu_mesh_flag(tmp_path):
+    rc = main(["32", "32", "8", "16", "--backend", "tpu", "--mesh", "2x4",
+               "--save", "--out-dir", str(tmp_path), "--name", "meshed",
+               "--seed", "5", "--quiet"])
+    assert rc == 0
+    rows, cols, gap, iters, procs = golio.read_master(
+        golio.master_path(str(tmp_path), "meshed"))
+    assert procs == 8  # one tile per device
+    final = golio.assemble(str(tmp_path), "meshed", 16)
+    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_visualizer_ascii_and_gif(tmp_path, capsys):
+    run_cli(tmp_path, "viz", "serial")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "golviz", os.path.join(os.path.dirname(__file__), "..", "tools",
+                               "gol_visualization.py"))
+    viz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(viz)
+    master = golio.master_path(str(tmp_path), "viz")
+    assert viz.main([master, "--format", "ascii"]) == 0
+    out = capsys.readouterr().out
+    assert "iteration 16" in out
+    gif = os.path.join(str(tmp_path), "viz.gif")
+    assert viz.main([master, "--format", "gif", "--out", gif]) == 0
+    assert os.path.getsize(gif) > 0

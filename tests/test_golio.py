@@ -1,0 +1,78 @@
+""".gol format: roundtrip, stitching, resume, and reference-format details
+(trailing tab, inclusive coordinate metadata)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import golio
+from mpi_tpu.utils.hashinit import init_tile_np
+
+
+def test_master_roundtrip(tmp_path):
+    d = str(tmp_path)
+    golio.write_master(d, "run", 64, 32, 10, 100, 4)
+    assert golio.read_master(golio.master_path(d, "run")) == (64, 32, 10, 100, 4)
+
+
+def test_tile_roundtrip(tmp_path):
+    d = str(tmp_path)
+    tile = init_tile_np(8, 12, seed=1)
+    golio.write_tile(d, "run", 5, 0, tile, first_row=16, first_col=24)
+    back, (r0, r1, c0, c1) = golio.read_tile(golio.tile_path(d, "run", 5, 0))
+    np.testing.assert_array_equal(back, tile)
+    assert (r0, r1, c0, c1) == (16, 23, 24, 35)
+
+
+def test_tile_format_trailing_tab(tmp_path):
+    # the reference's ostream_iterator writes "v\t" per value (main_serial.cpp:83)
+    d = str(tmp_path)
+    golio.write_tile(d, "run", 0, 0, np.array([[1, 0]], dtype=np.uint8), 0, 0)
+    with open(golio.tile_path(d, "run", 0, 0)) as f:
+        lines = f.readlines()
+    assert lines[0] == "0 0\n" and lines[1] == "0 1\n"
+    assert lines[2] == "1\t0\t\n"
+
+
+def test_assemble_multi_tile(tmp_path):
+    d = str(tmp_path)
+    full = init_tile_np(16, 16, seed=3)
+    golio.write_master(d, "run", 16, 16, 1, 1, 4)
+    tiles = [
+        (full[:8, :8], 0, 0), (full[:8, 8:], 0, 8),
+        (full[8:, :8], 8, 0), (full[8:, 8:], 8, 8),
+    ]
+    golio.write_snapshot_tiles(d, "run", 0, tiles)
+    np.testing.assert_array_equal(golio.assemble(d, "run", 0), full)
+
+
+def test_assemble_detects_gap(tmp_path):
+    d = str(tmp_path)
+    full = init_tile_np(16, 16, seed=3)
+    golio.write_master(d, "run", 16, 16, 1, 1, 2)
+    golio.write_snapshot_tiles(d, "run", 0, [(full[:8], 0, 0), (full[:8], 0, 0)])
+    with pytest.raises(ValueError, match="cover only"):
+        golio.assemble(d, "run", 0)
+
+
+def test_list_snapshot_iterations(tmp_path):
+    d = str(tmp_path)
+    t = np.zeros((4, 4), dtype=np.uint8)
+    for it in (0, 10, 20):
+        golio.write_tile(d, "run", it, 0, t, 0, 0)
+    golio.write_tile(d, "other", 5, 0, t, 0, 0)
+    assert golio.list_snapshot_iterations(d, "run") == [0, 10, 20]
+
+
+def test_snapshot_rewrite_removes_stale_tiles(tmp_path):
+    # resume path: iteration rewritten with fewer writers must not leave
+    # stale tiles that assemble would silently merge
+    d = str(tmp_path)
+    full = init_tile_np(16, 16, seed=4)
+    golio.write_master(d, "run", 16, 16, 1, 1, 4)
+    golio.write_snapshot_tiles(d, "run", 0, [
+        (full[:8, :8], 0, 0), (full[:8, 8:], 0, 8),
+        (full[8:, :8], 8, 0), (full[8:, 8:], 8, 8),
+    ])
+    other = init_tile_np(16, 16, seed=99)
+    golio.write_snapshot_tiles(d, "run", 0, [(other, 0, 0)])
+    np.testing.assert_array_equal(golio.assemble(d, "run", 0), other)

@@ -84,3 +84,12 @@ def test_highlife_sharded():
     g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
     out = np.asarray(jax.device_get(evolve(g, 20)))
     np.testing.assert_array_equal(out, evolve_np(g0, 20, HIGHLIFE, "periodic"))
+
+
+def test_run_tpu_automesh_validates(tmp_path):
+    # auto-chosen device mesh must fail fast on incompatible grids
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import ConfigError, GolConfig
+
+    with pytest.raises(ConfigError):
+        run_tpu(GolConfig(rows=30, cols=30, steps=1))  # 8 cpu devs: 2x4 mesh, 30%4!=0

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Visualize .gol snapshot runs from any mpi_tpu backend (and from the
+reference programs — the file format is wire-compatible; cf.
+/root/reference/gol_visualization.py, which this replaces with a headless
+renderer: GIF/PNG output instead of interactive pcolor windows, and an
+ASCII mode for terminals).
+
+Usage:
+    python tools/gol_visualization.py RUN.gol                 # RUN.gif
+    python tools/gol_visualization.py RUN.gol --format png    # RUN_<it>.png
+    python tools/gol_visualization.py RUN.gol --format ascii  # stdout
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_tpu import golio  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("master", help="path to the master .gol file")
+    p.add_argument("--format", choices=["gif", "png", "ascii"], default="gif")
+    p.add_argument("--out", default=None, help="output path (gif) or dir (png)")
+    p.add_argument("--fps", type=float, default=2.0)
+    p.add_argument("--max-frames", type=int, default=200)
+    args = p.parse_args(argv)
+
+    out_dir = os.path.dirname(args.master) or "."
+    name = os.path.splitext(os.path.basename(args.master))[0]
+    rows, cols, gap, iters, procs = golio.read_master(args.master)
+    print(f"{name}: {rows}x{cols}, gap={gap}, iterations={iters}, processes={procs}")
+
+    saved = golio.list_snapshot_iterations(out_dir, name)
+    if not saved:
+        print("no snapshot tiles found (was the run made with --save?)", file=sys.stderr)
+        return 1
+    saved = saved[: args.max_frames]
+
+    if args.format == "ascii":
+        for it in saved:
+            grid = golio.assemble(out_dir, name, it)
+            print(f"--- iteration {it} (population {int(grid.sum())}) ---")
+            for r in grid[:60]:
+                print("".join("#" if v else "." for v in r[:120]))
+        return 0
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib import animation
+
+    if args.format == "png":
+        png_dir = args.out or out_dir
+        os.makedirs(png_dir, exist_ok=True)
+        for it in saved:
+            grid = golio.assemble(out_dir, name, it)
+            fig, ax = plt.subplots(figsize=(6, 6 * rows / cols))
+            ax.imshow(grid, cmap="binary", interpolation="nearest")
+            ax.set_title(f"Iteration={it}")
+            ax.set_axis_off()
+            path = os.path.join(png_dir, f"{name}_{it}.png")
+            fig.savefig(path, dpi=120, bbox_inches="tight")
+            plt.close(fig)
+            print(f"wrote {path}")
+        return 0
+
+    # gif
+    out_path = args.out or os.path.join(out_dir, f"{name}.gif")
+    fig, ax = plt.subplots(figsize=(6, 6 * rows / cols))
+    ax.set_axis_off()
+    im = ax.imshow(
+        golio.assemble(out_dir, name, saved[0]),
+        cmap="binary", interpolation="nearest",
+    )
+    title = ax.set_title("")
+
+    def frame(k):
+        it = saved[k]
+        im.set_data(golio.assemble(out_dir, name, it))
+        title.set_text(f"Iteration={it}")
+        return [im, title]
+
+    anim = animation.FuncAnimation(fig, frame, frames=len(saved), blit=False)
+    anim.save(out_path, writer=animation.PillowWriter(fps=args.fps))
+    plt.close(fig)
+    print(f"wrote {out_path} ({len(saved)} frames)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,13 +30,15 @@ def main() -> None:
     from jax import lax
 
     from mpi_tpu.models.rules import LIFE
-    from mpi_tpu.ops.stencil import step
+    from mpi_tpu.ops.pallas_stencil import best_step_fn
     from mpi_tpu.utils.hashinit import init_tile_jnp
+
+    one_step = best_step_fn((SIZE, SIZE), LIFE)
 
     @functools.partial(jax.jit, static_argnames=("steps",))
     def evolve_pop(g, steps):
         out, _ = lax.scan(
-            lambda x, _: (step(x, LIFE, "periodic"), None), g, None, length=steps
+            lambda x, _: (one_step(x, LIFE, "periodic"), None), g, None, length=steps
         )
         return jnp.sum(out.astype(jnp.uint32))
 

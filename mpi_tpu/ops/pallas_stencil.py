@@ -1,0 +1,267 @@
+"""Fused Pallas TPU stencil kernel — the hot path for the per-step update.
+
+Why a kernel when XLA already fuses the shift-add stencil
+(``ops/stencil.py``)?  The XLA path materializes the padded array and the
+row-sum intermediate in HBM each step (~5x the grid's bytes of traffic);
+this kernel streams each row-block through VMEM exactly once: one HBM read
+per cell, one HBM write, everything else (vertical window sum, horizontal
+window sum via lane rotations, rule select) stays in registers/VMEM.  At
+HBM-bandwidth-bound sizes that is the difference between ~37 and >100
+G cell-updates/s on one v5e chip.
+
+Structure (cf. pallas_guide.md "Async DMA" / "Grid and Block
+Specifications"):
+
+* the grid stays **unpadded** in HBM (``memory_space=ANY``); the kernel
+  grid iterates over row blocks;
+* each program DMAs its block plus a radius-wide row halo into a VMEM
+  scratch (three DMAs: top halo, center, bottom halo — the top/bottom
+  start rows wrap modulo H, which implements periodic rows for free;
+  dead rows are zeroed with ``pl.when`` at the edge blocks);
+* column neighbors come from ``pltpu.roll`` lane rotations (periodic
+  columns for free; dead columns are masked with a lane iota);
+* the B/S rule is applied as interval compares, same as the XLA path.
+
+The row-block + halo DMA scheme is the single-chip mirror of the
+multi-chip design: what ``parallel/halo.py`` does with ``ppermute``
+between chips, this does with wrapped DMAs between row blocks of one
+chip's HBM.  Reference analog: the per-cell ``next()`` sweep
+(``/root/reference/main.cpp:79-103``), here as one VPU pass per block.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_tpu.models.rules import Rule, LIFE
+from mpi_tpu.ops.stencil import _in_any_interval
+
+
+def _pick_block_rows(H: int, W: int, radius: int) -> Optional[int]:
+    """Largest divisor of H with block bytes in a VMEM-friendly budget."""
+    del radius  # halo slabs are a fixed 8 rows for any supported radius
+    budget = 1 << 21  # 2 MiB per double-buffer slot (uint8, +16 halo rows)
+    best = None
+    for bm in (512, 256, 128, 64, 32, 16, 8):
+        if H % bm == 0 and (bm + 16) * W <= budget:
+            best = bm
+            break
+    return best
+
+
+def _pick_sub_rows(BM: int, W: int) -> int:
+    """Row sub-tile so each widened (SR, W) i32 temp stays <= 1 MiB."""
+    sr = BM
+    while sr > 8 and sr * W * 4 > (1 << 20):
+        sr //= 2
+    return sr
+
+
+def supports(shape, rule: Rule) -> bool:
+    """Shapes the kernel handles; callers fall back to the XLA path else."""
+    H, W = shape
+    return (
+        W % 128 == 0
+        and H >= 2 * rule.radius
+        and _pick_block_rows(H, W, rule.radius) is not None
+    )
+
+
+def _make_kernel(rule: Rule, boundary: str, H: int, W: int, BM: int):
+    r = rule.radius
+    win = 2 * r + 1
+    periodic = boundary == "periodic"
+    nblocks = H // BM
+    birth_iv = rule.birth_intervals
+    survive_iv = rule.survive_intervals
+
+    # DMA row slices must be aligned to the (8, 128) sublane tiling, so the
+    # halo slabs are a fixed 8 rows (>= r for every supported radius) and
+    # the kernel reads the r rows it needs from inside the slab.
+    HALO = 8
+    assert r <= HALO and BM % HALO == 0
+
+    def _block_dmas(in_hbm, scratch, sems, blk, slot):
+        """The three async copies loading block `blk` into scratch slot
+        `slot`: top halo slab, center rows, bottom halo slab.  Slab starts
+        wrap modulo H — periodic rows come out of the addressing; dead rows
+        are zeroed at compute time.  rem() hides divisibility from the
+        compiler, so re-assert the 8-row alignment of the wrapped starts
+        (base and H are multiples of HALO)."""
+        base = blk * BM
+        top = pl.multiple_of(lax.rem(base - HALO + H, H), HALO)
+        bot = pl.multiple_of(lax.rem(base + BM, H), HALO)
+        return (
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(top, HALO), :],
+                scratch.at[slot, pl.ds(0, HALO), :],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(base, BM), :],
+                scratch.at[slot, pl.ds(HALO, BM), :],
+                sems.at[slot, 1],
+            ),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(bot, HALO), :],
+                scratch.at[slot, pl.ds(HALO + BM, HALO), :],
+                sems.at[slot, 2],
+            ),
+        )
+
+    def kernel(in_hbm, out_ref, dbuf, sems):
+        # Double-buffered streaming (pallas_guide.md "Patterns: Double
+        # Buffering"): scratch persists across grid programs, so program i
+        # prefetches block i+1 into the other slot before computing block i
+        # — the next block's HBM reads overlap this block's VPU work.
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+        next_slot = lax.rem(i + 1, 2)
+
+        @pl.when(i == 0)
+        def _():
+            for d in _block_dmas(in_hbm, dbuf, sems, 0, 0):
+                d.start()
+
+        @pl.when(i + 1 < nblocks)
+        def _():
+            for d in _block_dmas(in_hbm, dbuf, sems, i + 1, next_slot):
+                d.start()
+
+        for d in _block_dmas(in_hbm, dbuf, sems, i, slot):
+            d.wait()
+
+        scratch = dbuf.at[slot]
+
+        if not periodic:
+            @pl.when(i == 0)
+            def _():
+                scratch[0:HALO, :] = jnp.zeros((HALO, W), dtype=jnp.uint8)
+
+            @pl.when(i == nblocks - 1)
+            def _():
+                scratch[HALO + BM :, :] = jnp.zeros((HALO, W), dtype=jnp.uint8)
+
+        # Mosaic vector arithmetic needs i16/i32 and lane rotates need i32,
+        # so sums are computed widened — but widening the whole block would
+        # blow VMEM at large widths.  Process the block in row sub-tiles:
+        # only (SR, W) i32 temporaries are ever live.
+        SR = _pick_sub_rows(BM, W)
+        lane = (
+            None if periodic
+            else lax.broadcasted_iota(jnp.int32, (SR, W), dimension=1)
+        )
+        for s0 in range(0, BM, SR):
+            lo = HALO - r + s0
+            v = scratch[lo : lo + SR, :].astype(jnp.int32)
+            for k in range(1, win):
+                v = v + scratch[lo + k : lo + k + SR, :].astype(jnp.int32)
+            # horizontal window sum via lane rotations; pltpu.roll takes
+            # non-negative shifts: shift s rotates lanes right (column j
+            # reads j-s); the left rotation is shift W-s.
+            h = v
+            if periodic:
+                for s in range(1, r + 1):
+                    h = h + pltpu.roll(v, s, axis=1) + pltpu.roll(v, W - s, axis=1)
+            else:
+                zero = jnp.zeros_like(v)
+                for s in range(1, r + 1):
+                    left = jnp.where(lane >= s, pltpu.roll(v, s, axis=1), zero)
+                    right = jnp.where(lane < W - s, pltpu.roll(v, W - s, axis=1), zero)
+                    h = h + left + right
+            center = scratch[HALO + s0 : HALO + s0 + SR, :].astype(jnp.int32)
+            counts = h - center
+            # keep the select in i32 lanes; a single i32->i8 truncation at
+            # the store is the only narrow op Mosaic needs to handle
+            born = _in_any_interval(counts, birth_iv).astype(jnp.int32)
+            keep = _in_any_interval(counts, survive_iv).astype(jnp.int32)
+            out_ref[s0 : s0 + SR, :] = jnp.where(center != 0, keep, born).astype(
+                jnp.uint8
+            )
+
+    return kernel
+
+
+def pallas_step(
+    grid: jax.Array,
+    rule: Rule = LIFE,
+    boundary: str = "periodic",
+    interpret: bool = False,
+) -> jax.Array:
+    """One generation on a single device via the fused kernel.
+    Requires ``supports(grid.shape, rule)``."""
+    H, W = grid.shape
+    BM = _pick_block_rows(H, W, rule.radius)
+    if BM is None or not supports(grid.shape, rule):
+        raise ValueError(
+            f"pallas_step does not support shape {grid.shape} "
+            f"(need W % 128 == 0 and a VMEM-sized row-block divisor of H)"
+        )
+    r = rule.radius
+    kernel = _make_kernel(rule, boundary, H, W, BM)
+    return pl.pallas_call(
+        kernel,
+        grid=(H // BM,),
+        out_shape=jax.ShapeDtypeStruct((H, W), jnp.uint8),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((BM, W), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            # two slots of (BM + two 8-row halo slabs) for double buffering
+            pltpu.VMEM((2, BM + 16, W), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=interpret,
+    )(grid)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rule", "boundary", "steps", "interpret"), donate_argnums=0
+)
+def _evolve_pallas(grid, rule, boundary, steps, interpret):
+    def body(g, _):
+        return pallas_step(g, rule, boundary, interpret=interpret), None
+
+    out, _ = lax.scan(body, grid, None, length=steps)
+    return out
+
+
+def make_pallas_stepper(rule: Rule = LIFE, boundary: str = "periodic", interpret: bool = False):
+    """evolve(grid, steps) using the fused kernel per step."""
+
+    def evolve(grid: jax.Array, steps: int) -> jax.Array:
+        return _evolve_pallas(grid, rule, boundary, steps, interpret)
+
+    return evolve
+
+
+def use_pallas(shape, rule: Rule) -> bool:
+    """Single source of truth for the kernel-vs-XLA dispatch: the fused
+    kernel needs a real TPU backend and a supported shape."""
+    return jax.default_backend() == "tpu" and supports(shape, rule)
+
+
+def best_step_fn(shape, rule: Rule = LIFE):
+    """step(grid, rule, boundary) — fused kernel where eligible, XLA else."""
+    if use_pallas(shape, rule):
+        return pallas_step
+    from mpi_tpu.ops.stencil import step
+
+    return step
+
+
+def best_stepper(shape, rule: Rule = LIFE, boundary: str = "periodic"):
+    """The fastest available single-device stepper for this shape: the
+    fused Pallas kernel on TPU when the shape qualifies, else the XLA
+    shift-add path (which works everywhere, any shape)."""
+    if use_pallas(shape, rule):
+        return make_pallas_stepper(rule, boundary)
+    from mpi_tpu.ops.stencil import make_stepper
+
+    return make_stepper(rule, boundary)

@@ -1,0 +1,63 @@
+"""Pallas kernel parity (interpret mode on CPU) vs the numpy oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mpi_tpu.models.rules import LIFE, HIGHLIFE, SEEDS, BOSCO, Rule
+from mpi_tpu.ops.pallas_stencil import pallas_step, supports, _pick_block_rows
+from mpi_tpu.backends.serial_np import step_np, evolve_np
+from mpi_tpu.utils.hashinit import init_tile_np
+
+
+def _pstep(g, rule, boundary):
+    return np.asarray(pallas_step(jnp.asarray(g), rule, boundary, interpret=True))
+
+
+@pytest.mark.parametrize("rule", [LIFE, HIGHLIFE, SEEDS], ids=lambda r: r.name)
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_pallas_step_parity(rule, boundary):
+    g = init_tile_np(32, 128, seed=3)
+    np.testing.assert_array_equal(_pstep(g, rule, boundary), step_np(g, rule, boundary))
+
+
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_pallas_deep_radius(boundary):
+    g = init_tile_np(32, 128, seed=9)
+    np.testing.assert_array_equal(_pstep(g, BOSCO, boundary), step_np(g, BOSCO, boundary))
+
+
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_pallas_multiblock(boundary):
+    # H=4096, W=128 → BM=512, 8 grid programs: exercises the double-buffer
+    # slot rotation, prefetch, and wrapped cross-block halo DMAs
+    assert _pick_block_rows(4096, 128, 1) == 512
+    g = init_tile_np(4096, 128, seed=5)
+    out = _pstep(g, LIFE, boundary)
+    np.testing.assert_array_equal(out, step_np(g, LIFE, boundary))
+
+
+def test_pallas_multiblock_deep_radius():
+    g = init_tile_np(4096, 128, seed=6)
+    np.testing.assert_array_equal(
+        _pstep(g, BOSCO, "periodic"), step_np(g, BOSCO, "periodic")
+    )
+
+
+def test_pallas_rect_wide():
+    g = init_tile_np(16, 256, seed=7)
+    np.testing.assert_array_equal(
+        _pstep(g, LIFE, "dead"), step_np(g, LIFE, "dead")
+    )
+
+
+def test_supports():
+    assert supports((64, 128), LIFE)
+    assert not supports((64, 100), LIFE)       # W not lane-aligned
+    assert not supports((6, 128), BOSCO)       # H < 2r
+    assert _pick_block_rows(64, 128, 1) == 64  # whole grid fits one block
+
+
+def test_pallas_rejects_unsupported():
+    with pytest.raises(ValueError):
+        pallas_step(jnp.zeros((64, 100), dtype=jnp.uint8), LIFE, "periodic", interpret=True)

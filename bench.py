@@ -1,13 +1,15 @@
 #!/usr/bin/env python
 """Flagship benchmark — one JSON line for the driver.
 
-Metric: cell-updates/sec for Conway's Life (periodic) on one chip,
-16384² grid — the reference's derived throughput metric
-(cells/sec = gszI·gszJ·nIter / t_nosetup, /root/reference/main.cpp:337-347)
-measured the XLA way: the whole multi-step evolution is one compiled scan,
-with a scalar population reduction as output so timing excludes host
-transfer of the grid (the device↔host tunnel is slow and would otherwise
-dominate; block_until_ready alone under-reports on this platform).
+Metric: cell-updates/sec for Conway's Life (periodic) on one chip on the
+north-star grid (65536², the BASELINE.json weak-scaling config) — the
+reference's derived throughput metric (cells/sec = gszI·gszJ·nIter /
+t_nosetup, /root/reference/main.cpp:337-347) measured the XLA way: the
+whole multi-step evolution is one compiled scan over the fused Pallas
+SWAR kernel (ops/pallas_bitlife.py, 32 cells per uint32 lane), with a
+scalar popcount reduction as output so timing excludes host transfer of
+the grid (the device<->host tunnel is slow and would otherwise dominate;
+block_until_ready alone under-reports on this platform).
 
 vs_baseline: ratio to the north star's per-chip share — BASELINE.json
 targets >= 1e11 cells/s on v5e-64, i.e. 1.5625e9 per chip.
@@ -19,8 +21,8 @@ import time
 
 import numpy as np
 
-SIZE = 16384
-STEPS = 200
+SIZE = 65536
+STEPS = 50
 BASELINE_PER_CHIP = 1e11 / 64
 
 
@@ -30,19 +32,21 @@ def main() -> None:
     from jax import lax
 
     from mpi_tpu.models.rules import LIFE
-    from mpi_tpu.ops.pallas_stencil import best_step_fn
-    from mpi_tpu.utils.hashinit import init_tile_jnp
+    from mpi_tpu.ops.bitlife import init_packed
+    from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, supports
 
-    one_step = best_step_fn((SIZE, SIZE), LIFE)
+    assert supports((SIZE, SIZE), LIFE)
 
     @functools.partial(jax.jit, static_argnames=("steps",))
-    def evolve_pop(g, steps):
+    def evolve_pop(p, steps):
         out, _ = lax.scan(
-            lambda x, _: (one_step(x, LIFE, "periodic"), None), g, None, length=steps
+            lambda x, _: (pallas_bit_step(x, LIFE, "periodic"), None),
+            p, None, length=steps,
         )
-        return jnp.sum(out.astype(jnp.uint32))
+        # popcount over packed words -> scalar (4-byte host fetch)
+        return jnp.sum(lax.population_count(out).astype(jnp.uint32))
 
-    grid = init_tile_jnp(SIZE, SIZE, seed=1)
+    grid = init_packed(SIZE, SIZE, seed=1)
     int(np.asarray(evolve_pop(grid, STEPS)))  # compile + warm ("setup")
     best = 0.0
     for _ in range(3):

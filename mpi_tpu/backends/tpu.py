@@ -60,16 +60,30 @@ def run_tpu(
 
     # Auto-chosen meshes must pass the same compatibility checks as
     # explicit --mesh shapes (fail fast, not deep in shard_map).
-    validate_mesh(
-        config.rows, config.cols,
-        (mesh.shape[AXES[0]], mesh.shape[AXES[1]]), config.rule.radius,
-    )
-    evolve = make_sharded_stepper(mesh, config.rule, config.boundary)
+    mi, mj = mesh.shape[AXES[0]], mesh.shape[AXES[1]]
+    validate_mesh(config.rows, config.cols, (mi, mj), config.rule.radius)
 
-    if initial is not None:
-        grid = jax.device_put(np.asarray(initial, dtype=np.uint8), grid_sharding(mesh))
+    # Engine choice: bitpacked SWAR (32 cells/lane) for radius-1 rules when
+    # every shard's width packs into whole uint32 words; dense uint8 else.
+    from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
+
+    packed_mode = config.rule.radius == 1 and (config.cols // mj) % WORD == 0
+    if packed_mode:
+        from mpi_tpu.parallel.step import (
+            make_sharded_bit_stepper, sharded_bit_init, make_sharded_unpacker,
+        )
+
+        evolve = make_sharded_bit_stepper(mesh, config.rule, config.boundary)
+        if initial is not None:
+            grid = jax.device_put(pack_np(initial), grid_sharding(mesh))
+        else:
+            grid = sharded_bit_init(mesh, config.rows, config.cols, config.seed)
     else:
-        grid = sharded_init(mesh, config.rows, config.cols, config.seed)
+        evolve = make_sharded_stepper(mesh, config.rule, config.boundary)
+        if initial is not None:
+            grid = jax.device_put(np.asarray(initial, dtype=np.uint8), grid_sharding(mesh))
+        else:
+            grid = sharded_init(mesh, config.rows, config.cols, config.seed)
 
     want_snapshots = snapshot_cb is not None and config.snapshot_every > 0
     segments = plan_segments(config.steps, config.snapshot_every if want_snapshots else 0)
@@ -82,18 +96,24 @@ def run_tpu(
     jax.block_until_ready(grid)
     timer.setup_done()
 
+    unpacker = make_sharded_unpacker(mesh) if packed_mode and want_snapshots else None
+
+    def tiles_of(g):
+        return _shard_tiles(unpacker(g) if unpacker is not None else g)
+
     it = start_iteration
     if want_snapshots and it == 0:
-        snapshot_cb(0, _shard_tiles(grid))
+        snapshot_cb(0, tiles_of(grid))
     for n in segments:
         grid = compiled[n](grid)
         it += n
         if want_snapshots:
             jax.block_until_ready(grid)
-            snapshot_cb(it, _shard_tiles(grid))
+            snapshot_cb(it, tiles_of(grid))
     jax.block_until_ready(grid)
     timer.finish()
-    return np.asarray(jax.device_get(grid))
+    final = np.asarray(jax.device_get(grid))
+    return unpack_np(final) if packed_mode else final
 
 
 def device_count() -> int:

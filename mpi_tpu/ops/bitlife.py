@@ -1,0 +1,252 @@
+"""Bitpacked (SWAR) engine for radius-1 B/S rules — 32 cells per uint32
+lane, bit-parallel neighbor counting.
+
+The dense paths (``ops/stencil.py``, ``ops/pallas_stencil.py``) spend ~15
+vector ops per *cell*; at one uint8 cell per lane-byte the VPU becomes the
+bottleneck around ~70 G cell-updates/s/chip.  Packing 32 cells into each
+uint32 lane turns the same VPU ops into 32-cell-wide bitwise arithmetic:
+~35 ops per *word* ≈ 1 op/cell, and HBM traffic drops 8x.  This is the
+classic bit-parallel Game-of-Life technique re-expressed for the TPU VPU,
+and it is how the framework beats the north-star throughput target per
+chip instead of merely meeting it.
+
+Scheme (exact neighbor counts, center excluded, so any radius-1 B/S rule
+works — Life, HighLife, Seeds, Day & Night):
+
+* column sums via a carry-save adder over the three row words
+  (up/mid/down): full 3-bit column ``f = u + m + d`` for the side columns,
+  2-bit ``c = u + d`` for the center column (center cell excluded — this
+  avoids a 4-bit subtraction later);
+* horizontal gather via word shifts with cross-word carries
+  (LSB = lowest column index): ``L = (x << 1) | (prev >> 31)``,
+  ``R = (x >> 1) | (next << 31)``;
+* total count ``N = L + C + R`` (max 8) via a two-layer adder producing
+  exact bits n0, n1, n2, n3;
+* the rule becomes a boolean function of (n3..n0, alive), built as an OR
+  of bit-pattern matches over the rule's count sets.
+
+Everything is uint32 elementwise — XLA fuses the whole step into one pass
+on any backend, and the identical code runs inside ``shard_map`` (the
+halo exchange just also shifts the packed edge words, ``parallel``
+integration) and under ``lax.scan``.
+
+Layout: (H, W) cells → (H, W/32) uint32; bit ``j`` of word ``w`` is the
+cell at column ``w*32 + j``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_tpu.models.rules import Rule, LIFE
+
+WORD = 32
+
+
+def packable(shape: Tuple[int, int], rule: Rule) -> bool:
+    return rule.radius == 1 and shape[1] % WORD == 0
+
+
+def pack(grid: jax.Array) -> jax.Array:
+    """(H, W) uint8 0/1 → (H, W/32) uint32, LSB = lowest column."""
+    H, W = grid.shape
+    if W % WORD:
+        raise ValueError(f"width {W} not a multiple of {WORD}")
+    bits = grid.reshape(H, W // WORD, WORD).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)
+    return (bits * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack(packed: jax.Array) -> jax.Array:
+    """(H, W/32) uint32 → (H, W) uint8 0/1."""
+    H, nw = packed.shape
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    bits = (packed[:, :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(H, nw * WORD).astype(jnp.uint8)
+
+
+def init_packed(
+    rows: int,
+    cols: int,
+    seed: int,
+    row_offset=0,
+    col_offset=0,
+    block_rows: int = 1024,
+) -> jax.Array:
+    """Hash-init a grid tile directly in packed form, streaming over row
+    blocks — a 65536² grid (512 MiB packed) initializes without ever
+    materializing the 4 GiB unpacked uint8 array or the 16 GiB pack()
+    intermediate.  Offsets make it decomposition-invariant like
+    ``init_tile_jnp`` (traceable, usable inside shard_map)."""
+    if cols % WORD:
+        raise ValueError(f"cols {cols} not a multiple of {WORD}")
+    from mpi_tpu.utils.hashinit import init_tile_jnp
+
+    block_rows = min(block_rows, rows)
+    while rows % block_rows:
+        block_rows //= 2
+
+    def one_block(r0):
+        return pack(init_tile_jnp(block_rows, cols, seed, row_offset=r0,
+                                  col_offset=col_offset))
+
+    starts = jnp.uint32(row_offset) + jnp.arange(0, rows, block_rows, dtype=jnp.uint32)
+    blocks = lax.map(one_block, starts)
+    return blocks.reshape(rows, cols // WORD)
+
+
+def pack_np(grid) -> "np.ndarray":
+    """Host-side pack (numpy, blockwise to bound intermediates)."""
+    import numpy as np
+
+    grid = np.asarray(grid, dtype=np.uint8)
+    H, W = grid.shape
+    if W % WORD:
+        raise ValueError(f"width {W} not a multiple of {WORD}")
+    out = np.empty((H, W // WORD), dtype=np.uint32)
+    weights = (np.uint32(1) << np.arange(WORD, dtype=np.uint32))
+    step_rows = max(1, (1 << 24) // max(W, 1))
+    for r0 in range(0, H, step_rows):
+        blk = grid[r0 : r0 + step_rows]
+        out[r0 : r0 + step_rows] = (
+            blk.reshape(blk.shape[0], -1, WORD).astype(np.uint32) * weights
+        ).sum(axis=-1, dtype=np.uint32)
+    return out
+
+
+def unpack_np(packed) -> "np.ndarray":
+    """Host-side unpack (numpy, blockwise — the naive (H, nw, 32) uint32
+    intermediate would be ~32 GiB for a 65536² grid)."""
+    import numpy as np
+
+    packed = np.asarray(packed)
+    H, nw = packed.shape
+    out = np.empty((H, nw * WORD), dtype=np.uint8)
+    shifts = np.arange(WORD, dtype=np.uint32)
+    step_rows = max(1, (1 << 24) // max(nw * WORD, 1))
+    for r0 in range(0, H, step_rows):
+        blk = packed[r0 : r0 + step_rows]
+        bits = (blk[:, :, None] >> shifts) & np.uint32(1)
+        out[r0 : r0 + step_rows] = bits.reshape(blk.shape[0], -1).astype(np.uint8)
+    return out
+
+
+def _maj(a, b, c):
+    return (a & b) | (c & (a ^ b))
+
+
+def _rule_predicate(counts_bits, intervals):
+    """OR of 4-bit equality matches for every count in the rule set.
+    counts_bits = (n0, n1, n2, n3); returns a uint32 bitmask."""
+    n0, n1, n2, n3 = counts_bits
+    acc = None
+    for lo, hi in intervals:
+        for k in range(lo, hi + 1):
+            m = n0 if k & 1 else ~n0
+            m = m & (n1 if k & 2 else ~n1)
+            m = m & (n2 if k & 4 else ~n2)
+            m = m & (n3 if k & 8 else ~n3)
+            acc = m if acc is None else acc | m
+    if acc is None:
+        return jnp.uint32(0)
+    return acc
+
+
+def bit_neighbor_bits(up, mid, down, up_p, mid_p, down_p, up_n, mid_n, down_n):
+    """Exact neighbor-count bits (n0..n3) for each cell bit, given the
+    packed word rows (up/mid/down) and their previous/next words along the
+    row (for the cross-word shift carries)."""
+    one = jnp.uint32(1)
+    t31 = jnp.uint32(31)
+
+    # column sums: side columns need u+m+d (0..3), center column u+d (0..2)
+    f0 = up ^ mid ^ down
+    f1 = _maj(up, mid, down)
+    c0 = up ^ down
+    c1 = up & down
+    # the same sums for the neighboring words (for carry bits)
+    fp0 = up_p ^ mid_p ^ down_p
+    fp1 = _maj(up_p, mid_p, down_p)
+    fn0 = up_n ^ mid_n ^ down_n
+    fn1 = _maj(up_n, mid_n, down_n)
+
+    # horizontal shifts: L = column to the left of each cell, R = right
+    L0 = (f0 << one) | (fp0 >> t31)
+    L1 = (f1 << one) | (fp1 >> t31)
+    R0 = (f0 >> one) | (fn0 << t31)
+    R1 = (f1 >> one) | (fn1 << t31)
+
+    # N = L + C + R (L, R are 2-bit 0..3; C is 2-bit 0..2; max 8)
+    n0 = L0 ^ c0 ^ R0
+    ca = _maj(L0, c0, R0)                      # weight-2 carry
+    n1 = L1 ^ c1 ^ R1 ^ ca
+    pairs = (L1 & c1) | (L1 & R1) | (L1 & ca) | (c1 & R1) | (c1 & ca) | (R1 & ca)
+    all4 = L1 & c1 & R1 & ca
+    n2 = pairs & ~all4                         # weight-4 bit
+    n3 = all4                                  # weight-8 bit (count == 8)
+    return n0, n1, n2, n3
+
+
+def bit_step_rows(up, mid, down, up_p, mid_p, down_p, up_n, mid_n, down_n, rule: Rule):
+    """Next state of the `mid` row words given all nine packed inputs."""
+    bits = bit_neighbor_bits(up, mid, down, up_p, mid_p, down_p, up_n, mid_n, down_n)
+    born = _rule_predicate(bits, rule.birth_intervals)
+    keep = _rule_predicate(bits, rule.survive_intervals)
+    return (mid & keep) | (~mid & born)
+
+
+def bit_step(packed: jax.Array, rule: Rule = LIFE, boundary: str = "periodic") -> jax.Array:
+    """One generation on a packed (H, W/32) uint32 grid, single device."""
+    if rule.radius != 1:
+        raise ValueError("bitpacked engine supports radius-1 rules only")
+    periodic = boundary == "periodic"
+    zero_row = jnp.zeros_like(packed[:1])
+    zero_col = jnp.zeros_like(packed[:, :1])
+
+    if periodic:
+        up = jnp.roll(packed, 1, axis=0)
+        down = jnp.roll(packed, -1, axis=0)
+    else:
+        up = jnp.concatenate([zero_row, packed[:-1]], axis=0)
+        down = jnp.concatenate([packed[1:], zero_row], axis=0)
+
+    def word_shift(x, direction):
+        # previous/next word along the row for cross-word bit carries
+        if periodic:
+            return jnp.roll(x, direction, axis=1)
+        if direction == 1:
+            return jnp.concatenate([zero_col, x[:, :-1]], axis=1)
+        return jnp.concatenate([x[:, 1:], zero_col], axis=1)
+
+    return bit_step_rows(
+        up, packed, down,
+        word_shift(up, 1), word_shift(packed, 1), word_shift(down, 1),
+        word_shift(up, -1), word_shift(packed, -1), word_shift(down, -1),
+        rule,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rule", "boundary", "steps"), donate_argnums=0
+)
+def _evolve_bits(packed, rule, boundary, steps):
+    def body(p, _):
+        return bit_step(p, rule, boundary), None
+
+    out, _ = lax.scan(body, packed, None, length=steps)
+    return out
+
+
+def make_bit_stepper(rule: Rule = LIFE, boundary: str = "periodic"):
+    """evolve(grid_u8, steps) -> grid_u8, running packed internally."""
+
+    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
+    def evolve(grid: jax.Array, steps: int) -> jax.Array:
+        return unpack(_evolve_bits(pack(grid), rule, boundary, steps))
+
+    return evolve

@@ -1,0 +1,188 @@
+"""Pallas kernel over the bitpacked representation — the fastest engine.
+
+The XLA bitpacked path (``ops/bitlife.py``) materializes the rolled
+up/down rows and the six shifted word arrays in HBM each step, which
+makes it bandwidth-bound at large grids.  This kernel streams row blocks
+of the packed (H, W/32) uint32 grid through VMEM exactly as
+``ops/pallas_stencil.py`` does for dense uint8 — same double-buffered
+halo-slab DMA scaffold — but the per-block compute is the SWAR adder
+tree of ``bitlife.bit_neighbor_bits``: all word shifts and lane rotations
+happen in registers, so HBM sees one packed read and one packed write per
+block (0.25 bytes per cell per step).
+
+Periodic rows come from the modulo-wrapped slab DMAs; periodic columns
+from ``pltpu.roll`` lane rotation (the cross-word carry bits ride along
+inside the rotated words).  Dead boundary: edge slabs zeroed, rotated
+edge words masked with a lane iota.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_tpu.models.rules import Rule, LIFE
+from mpi_tpu.ops.bitlife import WORD, bit_step_rows, packable
+
+
+def _pick_block_rows(H: int, NW: int) -> int | None:
+    # 1 MiB per double-buffer slot: the SWAR compute keeps ~12 (BM, NW)
+    # u32 temporaries live, so the slot budget must leave most of the
+    # 16 MiB VMEM for them (2 MiB slots overflowed at NW=2048 by 28 KB).
+    budget = 1 << 20
+    for bm in (512, 256, 128, 64, 32, 16, 8):
+        if H % bm == 0 and (bm + 16) * NW * 4 <= budget:
+            return bm
+    return None
+
+
+def supports(shape, rule: Rule) -> bool:
+    """(H, W) cell-space shapes this kernel handles."""
+    H, W = shape
+    return (
+        packable(shape, rule)
+        and (W // WORD) % 128 == 0  # packed width must stay lane-aligned
+        and H >= 8
+        and _pick_block_rows(H, W // WORD) is not None
+    )
+
+
+def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int):
+    periodic = boundary == "periodic"
+    nblocks = H // BM
+    HALO = 8  # DMA row slices must be 8-sublane aligned; radius is 1
+
+    def _block_dmas(in_hbm, dbuf, sems, blk, slot):
+        base = blk * BM
+        top = pl.multiple_of(lax.rem(base - HALO + H, H), HALO)
+        bot = pl.multiple_of(lax.rem(base + BM, H), HALO)
+        return (
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(top, HALO), :],
+                dbuf.at[slot, pl.ds(0, HALO), :],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(base, BM), :],
+                dbuf.at[slot, pl.ds(HALO, BM), :],
+                sems.at[slot, 1],
+            ),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(bot, HALO), :],
+                dbuf.at[slot, pl.ds(HALO + BM, HALO), :],
+                sems.at[slot, 2],
+            ),
+        )
+
+    def kernel(in_hbm, out_ref, dbuf, sems):
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+        next_slot = lax.rem(i + 1, 2)
+
+        @pl.when(i == 0)
+        def _():
+            for d in _block_dmas(in_hbm, dbuf, sems, 0, 0):
+                d.start()
+
+        @pl.when(i + 1 < nblocks)
+        def _():
+            for d in _block_dmas(in_hbm, dbuf, sems, i + 1, next_slot):
+                d.start()
+
+        for d in _block_dmas(in_hbm, dbuf, sems, i, slot):
+            d.wait()
+
+        scratch = dbuf.at[slot]
+
+        if not periodic:
+            @pl.when(i == 0)
+            def _():
+                scratch[HALO - 1 : HALO, :] = jnp.zeros((1, NW), dtype=jnp.uint32)
+
+            @pl.when(i == nblocks - 1)
+            def _():
+                scratch[HALO + BM : HALO + BM + 1, :] = jnp.zeros((1, NW), dtype=jnp.uint32)
+
+        lane = (
+            None if periodic
+            else lax.broadcasted_iota(jnp.int32, (BM, NW), dimension=1)
+        )
+
+        up = scratch[HALO - 1 : HALO - 1 + BM, :]
+        mid = scratch[HALO : HALO + BM, :]
+        down = scratch[HALO + 1 : HALO + 1 + BM, :]
+
+        def prev_word(x):
+            rolled = pltpu.roll(x, 1, axis=1)
+            if periodic:
+                return rolled
+            return jnp.where(lane == 0, jnp.uint32(0), rolled)
+
+        def next_word(x):
+            rolled = pltpu.roll(x, NW - 1, axis=1)
+            if periodic:
+                return rolled
+            return jnp.where(lane == NW - 1, jnp.uint32(0), rolled)
+
+        out_ref[:] = bit_step_rows(
+            up, mid, down,
+            prev_word(up), prev_word(mid), prev_word(down),
+            next_word(up), next_word(mid), next_word(down),
+            rule,
+        )
+
+    return kernel
+
+
+def pallas_bit_step(
+    packed: jax.Array,
+    rule: Rule = LIFE,
+    boundary: str = "periodic",
+    interpret: bool = False,
+) -> jax.Array:
+    """One generation on a packed (H, W/32) uint32 grid via the fused
+    SWAR kernel.  Requires ``supports((H, W), rule)``."""
+    H, NW = packed.shape
+    BM = _pick_block_rows(H, NW)
+    if rule.radius != 1 or BM is None:
+        raise ValueError(f"pallas_bit_step cannot handle packed shape {packed.shape}")
+    kernel = _make_kernel(rule, boundary, H, NW, BM)
+    return pl.pallas_call(
+        kernel,
+        grid=(H // BM,),
+        out_shape=jax.ShapeDtypeStruct((H, NW), jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((BM, NW), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, BM + 16, NW), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=interpret,
+    )(packed)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rule", "boundary", "steps", "interpret"), donate_argnums=0
+)
+def _evolve_bits_pallas(packed, rule, boundary, steps, interpret):
+    def body(p, _):
+        return pallas_bit_step(p, rule, boundary, interpret=interpret), None
+
+    out, _ = lax.scan(body, packed, None, length=steps)
+    return out
+
+
+def make_pallas_bit_stepper(
+    rule: Rule = LIFE, boundary: str = "periodic", interpret: bool = False
+):
+    """evolve(packed, steps) on packed uint32 grids."""
+
+    def evolve(packed: jax.Array, steps: int) -> jax.Array:
+        return _evolve_bits_pallas(packed, rule, boundary, steps, interpret)
+
+    return evolve

@@ -2,6 +2,20 @@
 
 from mpi_tpu.parallel.mesh import make_mesh
 from mpi_tpu.parallel.halo import exchange_halo
-from mpi_tpu.parallel.step import make_sharded_stepper, sharded_init
+from mpi_tpu.parallel.step import (
+    make_sharded_stepper,
+    sharded_init,
+    make_sharded_bit_stepper,
+    sharded_bit_init,
+    sharded_unpack,
+)
 
-__all__ = ["make_mesh", "exchange_halo", "make_sharded_stepper", "sharded_init"]
+__all__ = [
+    "make_mesh",
+    "exchange_halo",
+    "make_sharded_stepper",
+    "sharded_init",
+    "make_sharded_bit_stepper",
+    "sharded_bit_init",
+    "sharded_unpack",
+]

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Production batch launch — the role of the reference's PBS job
+# (/root/reference/gol.pbs: 5 nodes x 24 ppn, mpirun -np 100
+# ./gol 25000 25000 250 1000).
+#
+# On a TPU pod slice the process model inverts: one Python process per
+# host, all chips of the slice joined into one jax.sharding.Mesh; there is
+# no mpirun — the TPU runtime supplies the process group and
+# jax.distributed.initialize() (no-args) picks it up from the environment.
+# Launch this script on every host of the slice (e.g. with
+#   gcloud compute tpus tpu-vm ssh $TPU --worker=all --command="...gol.batch.sh"
+# ); each host drives its local chips and writes its own shard tiles.
+#
+# The configuration mirrors the reference's production run scaled to the
+# north-star config: 65536^2 grid, 1000 iterations, snapshot every 250.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+GRID=${GRID:-65536}
+ITERS=${ITERS:-1000}
+GAP=${GAP:-250}
+SEED=${SEED:-1}
+
+# MULTIHOST=1 joins the slice-wide process group (set it when launching on
+# every host of a pod slice; leave unset for single-host runs).  The run
+# name must be identical on every host, so derive it from the config
+# rather than per-host timestamps.
+NAME=${NAME:-batch-${GRID}x${GRID}-${ITERS}-s${SEED}}
+
+python -m mpi_tpu.cli "$GRID" "$GRID" "$GAP" "$ITERS" batch_timings "${FIRST:-1}" \
+  --backend tpu --seed "$SEED" --name "$NAME" ${SAVE:+--save} \
+  ${MULTIHOST:+--multihost} --out-dir "${OUT_DIR:-.}"

@@ -1,0 +1,226 @@
+// gol_native — standalone native CLI, runnable without Python.
+//
+// The reference ships two standalone binaries (./gol via mpirun and
+// ./gol_serial); this is the framework's equivalent front end over the
+// golcore engine: same positional contract
+//     rows cols iteration_gap iterations [time_file] [first]
+// (reference main.cpp:171-223) plus flags for what the reference
+// hardcoded: --workers N (multi-worker tile engine; the mpirun -np
+// analog), --boundary periodic|dead, --rule life|highlife|seeds|daynight,
+// --seed S, --save, --out-dir D, --name N.
+//
+// Emits the same .gol master/tile format as the Python CLI (golio.py), so
+// tools/gol_visualization.py and the parity tests consume its dumps
+// directly, and appends the reference-schema 12-column timing CSV
+// (main.cpp:356-363) with correctly-labeled microseconds.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void gol_init(uint8_t*, int64_t, int64_t, uint32_t, int64_t, int64_t);
+void gol_evolve(uint8_t*, int64_t, int64_t, int64_t, const uint8_t*,
+                const uint8_t*, int, int);
+int gol_evolve_par(uint8_t*, int64_t, int64_t, int64_t, const uint8_t*,
+                   const uint8_t*, int, int, int, int);
+}
+
+namespace {
+
+struct Rule {
+    const char* name;
+    uint8_t birth[9];
+    uint8_t survive[9];
+};
+
+// radius-1 built-ins (tables indexed by neighbor count 0..8)
+const Rule kRules[] = {
+    {"life",     {0,0,0,1,0,0,0,0,0}, {0,0,1,1,0,0,0,0,0}},
+    {"highlife", {0,0,0,1,0,0,1,0,0}, {0,0,1,1,0,0,0,0,0}},
+    {"seeds",    {0,0,1,0,0,0,0,0,0}, {0,0,0,0,0,0,0,0,0}},
+    {"daynight", {0,0,0,1,0,0,1,1,1}, {0,0,0,1,1,0,1,1,1}},
+};
+
+const Rule* find_rule(const std::string& n) {
+    for (const auto& r : kRules)
+        if (n == r.name) return &r;
+    return nullptr;
+}
+
+std::string timestamp_name() {
+    char buf[64];
+    time_t raw;
+    time(&raw);
+    strftime(buf, sizeof(buf), "%Y-%m-%d-%H-%M-%S", localtime(&raw));
+    return buf;
+}
+
+void write_tile(const std::string& dir, const std::string& name, int iter,
+                const uint8_t* grid, int64_t rows, int64_t cols) {
+    std::ofstream f(dir + "/" + name + "_" + std::to_string(iter) + "_0.gol");
+    f << 0 << " " << rows - 1 << "\n" << 0 << " " << cols - 1 << "\n";
+    for (int64_t i = 0; i < rows; ++i) {
+        for (int64_t j = 0; j < cols; ++j)
+            f << (grid[i * cols + j] ? "1" : "0") << "\t";
+        f << "\n";
+    }
+}
+
+void usage(const char* argv0) {
+    std::fprintf(stderr,
+        "usage: %s rows cols iteration_gap iterations [time_file] [first]\n"
+        "       [--workers N] [--boundary periodic|dead] [--rule NAME]\n"
+        "       [--seed S] [--save] [--out-dir D] [--name N]\n",
+        argv0);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    std::vector<std::string> pos;
+    int workers = 1;
+    std::string boundary = "periodic", rule_name = "life", out_dir = ".", name;
+    uint32_t seed = 0;
+    bool save = false;
+
+    for (int i = 1; i < argc; ++i) {
+        std::string a = argv[i];
+        auto next = [&](const char* flag) -> std::string {
+            if (i + 1 >= argc) {
+                std::fprintf(stderr, "%s needs a value\n", flag);
+                exit(2);
+            }
+            return argv[++i];
+        };
+        if (a == "--workers") workers = std::stoi(next("--workers"));
+        else if (a == "--boundary") boundary = next("--boundary");
+        else if (a == "--rule") rule_name = next("--rule");
+        else if (a == "--seed") seed = (uint32_t)std::stoul(next("--seed"));
+        else if (a == "--out-dir") out_dir = next("--out-dir");
+        else if (a == "--name") name = next("--name");
+        else if (a == "--save") save = true;
+        else if (a == "--help" || a == "-h") { usage(argv[0]); return 0; }
+        else pos.push_back(a);
+    }
+    if (pos.size() < 4 || pos.size() > 6) {
+        usage(argv[0]);
+        return 2;
+    }
+    int64_t rows, cols, gap, iters;
+    int first = 0;
+    std::string time_file;
+    try {
+        rows = std::stoll(pos[0]);
+        cols = std::stoll(pos[1]);
+        gap = std::stoll(pos[2]);
+        iters = std::stoll(pos[3]);
+        if (pos.size() > 4) time_file = pos[4];
+        if (pos.size() > 5) first = std::stoi(pos[5]);
+    } catch (...) {
+        std::fprintf(stderr, "One or more program arguments are invalid!\n");
+        return 2;
+    }
+    if (rows <= 0 || cols <= 0 || iters < 0 || gap < 0) {
+        std::fprintf(stderr, "Illegal board size parameter combination!\n");
+        return 2;
+    }
+    const Rule* rule = find_rule(rule_name);
+    if (!rule) {
+        std::fprintf(stderr, "unknown rule '%s'\n", rule_name.c_str());
+        return 2;
+    }
+    if (boundary != "periodic" && boundary != "dead") {
+        std::fprintf(stderr, "boundary must be periodic|dead\n");
+        return 2;
+    }
+    int periodic = boundary == "periodic" ? 1 : 0;
+    if (name.empty()) name = timestamp_name();
+    if (time_file.empty()) time_file = name;
+
+    auto t_begin = std::chrono::steady_clock::now();
+
+    std::vector<uint8_t> grid((size_t)(rows * cols));
+    gol_init(grid.data(), rows, cols, seed, 0, 0);
+
+    // worker-tile mesh: most-square factorization, shrinking the worker
+    // count until the mesh divides the grid (same policy as the Python
+    // bindings' plan_tiles); warn when degraded below the request.
+    int requested = workers;
+    int ti = 1, tj = 1;
+    for (int w = workers; w >= 1; --w) {
+        int a_best = 1;
+        for (int a = 1; (int64_t)a * a <= w; ++a)
+            if (w % a == 0) a_best = a;
+        int b = w / a_best;
+        if (rows % a_best == 0 && cols % b == 0 && rows / a_best >= 1 &&
+            cols / b >= 1) {
+            ti = a_best; tj = b;
+            break;
+        }
+    }
+    if (ti * tj != requested)
+        std::fprintf(stderr,
+                     "gol_native: %d workers requested, using %dx%d=%d "
+                     "(mesh must divide the grid)\n",
+                     requested, ti, tj, ti * tj);
+
+    // master manifest (one writer process)
+    {
+        std::ofstream f(out_dir + "/" + name + ".gol");
+        f << rows << " " << cols << " " << gap << " " << iters << " " << 1 << "\n";
+    }
+    if (save) write_tile(out_dir, name, 0, grid.data(), rows, cols);
+
+    auto t_setup = std::chrono::steady_clock::now();
+
+    int64_t done = 0;
+    while (done < iters) {
+        int64_t n = (save && gap > 0) ? std::min(gap, iters - done) : iters - done;
+        int rc = 0;
+        if (ti * tj > 1)
+            rc = gol_evolve_par(grid.data(), rows, cols, n, rule->birth,
+                                rule->survive, 1, periodic, ti, tj);
+        else
+            gol_evolve(grid.data(), rows, cols, n, rule->birth, rule->survive,
+                       1, periodic);
+        if (rc != 0) {
+            std::fprintf(stderr, "engine rejected %dx%d tile mesh (rc=%d)\n",
+                         ti, tj, rc);
+            return 1;
+        }
+        done += n;
+        if (save) write_tile(out_dir, name, (int)done, grid.data(), rows, cols);
+    }
+
+    auto t_end = std::chrono::steady_clock::now();
+    using us = std::chrono::microseconds;
+    long full = std::chrono::duration_cast<us>(t_end - t_begin).count();
+    long setup = std::chrono::duration_cast<us>(t_setup - t_begin).count();
+    long nosetup = full - setup;
+    int p = ti * tj;
+
+    std::ofstream csv(out_dir + "/" + time_file + "_compact.csv", std::ios::app);
+    if (first != 0)
+        csv << "X,Y,#P,full single,full avg,full sum,nosetup single,nosetup avg,"
+               "nosetup sum,setup single ,setup avg ,setup sum \n";
+    csv << rows << "," << cols << "," << p << "," << full << "," << full << ","
+        << full * p << "," << nosetup << "," << nosetup << "," << nosetup * p
+        << "," << setup << "," << setup << "," << setup * p << "\n";
+
+    long pop = 0;
+    for (uint8_t v : grid) pop += v;
+    std::printf("gol_native %s: %lldx%lld x%lld steps, %d workers, "
+                "%.3f Gcells/s, population %ld\n",
+                name.c_str(), (long long)rows, (long long)cols,
+                (long long)iters, p,
+                nosetup > 0 ? (double)rows * cols * iters / nosetup / 1e3 : 0.0,
+                pop);
+    return 0;
+}

@@ -22,22 +22,34 @@ from mpi_tpu.parallel.mesh import make_mesh
 from mpi_tpu.parallel.step import grid_sharding, make_sharded_stepper, sharded_init
 from mpi_tpu.utils.timing import PhaseTimer
 
-SnapshotCb = Callable[[int, List[Tuple[np.ndarray, int, int]]], None]
-# snapshot_cb(iteration, [(tile, first_row, first_col), ...]) — tiles in
-# pid order (row-major over the device mesh).
+SnapshotCb = Callable[[int, List[Tuple[int, np.ndarray, int, int]]], None]
+# snapshot_cb(iteration, [(pid, tile, first_row, first_col), ...]) —
+# pids are globally unique (row-major over the global tile grid), so each
+# host of a multi-host run can write its own shards without collisions.
 
 
-def _shard_tiles(grid: jax.Array) -> List[Tuple[np.ndarray, int, int]]:
-    """Per-device tiles of a sharded grid, row-major by global offset —
+def _shard_tiles(grid: jax.Array) -> List[Tuple[int, np.ndarray, int, int]]:
+    """(pid, tile, first_row, first_col) for every *addressable* shard —
     each device's shard becomes one .gol tile, the way each MPI rank wrote
-    its own tile in the reference (``main.cpp:106-129``)."""
+    its own tile in the reference (``main.cpp:106-129``).  The pid is the
+    row-major index of the shard's position in the global tile grid, so it
+    is globally unique even when multiple hosts each dump only their own
+    addressable shards."""
     shards = []
     for s in grid.addressable_shards:
         r0 = s.index[0].start or 0
         c0 = s.index[1].start or 0
         shards.append((np.asarray(s.data), r0, c0))
-    shards.sort(key=lambda t: (t[1], t[2]))
-    return shards
+    if not shards:
+        return []
+    th, tw = shards[0][0].shape
+    tiles_j = grid.shape[1] // tw
+    out = [
+        ((r0 // th) * tiles_j + (c0 // tw), tile, r0, c0)
+        for tile, r0, c0 in shards
+    ]
+    out.sort(key=lambda t: t[0])
+    return out
 
 
 def run_tpu(
@@ -48,7 +60,9 @@ def run_tpu(
     initial: Optional[np.ndarray] = None,
     start_iteration: int = 0,
 ):
-    """Run one configuration; returns the final grid as a host numpy array.
+    """Run one configuration; returns the final grid as a host numpy array
+    (or None under multi-host execution, where no single host can fetch
+    the global array — the snapshot tiles are the multi-host output).
 
     initial/start_iteration support checkpoint-restart: pass a grid loaded
     by ``golio.load_snapshot`` and the iteration it was saved at.
@@ -112,6 +126,10 @@ def run_tpu(
             snapshot_cb(it, tiles_of(grid))
     jax.block_until_ready(grid)
     timer.finish()
+    if jax.process_count() > 1:
+        # the global array spans non-addressable devices; hosts keep their
+        # shards (snapshots already wrote them) — no host-side global grid
+        return None
     final = np.asarray(jax.device_get(grid))
     return unpack_np(final) if packed_mode else final
 

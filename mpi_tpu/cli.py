@@ -67,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", default=None, metavar="NAME@ITER",
                    help="resume from snapshot ITER of run NAME; 'iterations' "
                    "then counts additional steps")
+    p.add_argument("--multihost", action="store_true",
+                   help="join a multi-host TPU slice via "
+                   "jax.distributed.initialize() (launch one process per "
+                   "host; the mpirun analog, reference gol.pbs)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR "
+                   "(tpu backend; the framework's jax-native answer to the "
+                   "reference's chrono timing blocks)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -100,6 +108,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _run(args) -> int:
+    if args.multihost:
+        # must precede any other jax usage (the backend reads the process
+        # group at initialization; the reference's MPI_Init analog)
+        import jax
+
+        jax.distributed.initialize()
+        _log(args.quiet,
+             f"multihost: process {jax.process_index()}/{jax.process_count()}, "
+             f"{jax.local_device_count()} local of {jax.device_count()} devices")
     rule = rule_from_name(args.rule)
     mesh_shape = _parse_mesh(args.mesh)
     config = GolConfig(
@@ -118,7 +135,17 @@ def _run(args) -> int:
     if args.strict:
         config.validate_strict()
 
-    name = args.name or _time.strftime("%Y-%m-%d-%H-%M-%S")
+    import os
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    if args.name:
+        name = args.name
+    elif args.multihost:
+        # per-host timestamps can straddle a second boundary and split the
+        # run across names; derive a deterministic name from the config
+        name = f"run-{args.rows}x{args.cols}-{args.iterations}-s{args.seed}"
+    else:
+        name = _time.strftime("%Y-%m-%d-%H-%M-%S")
     timer = PhaseTimer()
 
     initial = None
@@ -176,18 +203,35 @@ def _run(args) -> int:
         golio.write_snapshot_tiles(args.out_dir, name, iteration, tiles)
 
     if config.backend == "tpu":
+        import contextlib
+
         from mpi_tpu.backends.tpu import run_tpu
 
         def cb(iteration, tiles):
-            golio.write_snapshot_tiles(args.out_dir, name, iteration, tiles)
+            # tiles carry globally-unique pids (multi-host: each host
+            # writes only its addressable shards)
+            for pid, tile, r0, c0 in tiles:
+                golio.write_tile(args.out_dir, name, iteration, pid, tile, r0, c0)
+            import jax
 
-        final = run_tpu(
-            config,
-            timer=timer,
-            snapshot_cb=cb if args.save else None,
-            initial=initial,
-            start_iteration=start_iter,
-        )
+            if jax.process_count() == 1:
+                golio.remove_stale_tiles(
+                    args.out_dir, name, iteration, [t[0] for t in tiles]
+                )
+
+        profile_ctx = contextlib.nullcontext()
+        if args.profile:
+            import jax
+
+            profile_ctx = jax.profiler.trace(args.profile)
+        with profile_ctx:
+            final = run_tpu(
+                config,
+                timer=timer,
+                snapshot_cb=cb if args.save else None,
+                initial=initial,
+                start_iteration=start_iter,
+            )
     else:
         if config.backend == "serial":
             from mpi_tpu.backends.serial_np import evolve_np as _evolve
@@ -236,7 +280,8 @@ def _run(args) -> int:
     cps = timer.cells_per_sec(config.rows, config.cols, config.steps)
     _log(args.quiet,
          f"done: setup {timer.setup_us / 1e6:.2f}s, steady {timer.nosetup_us / 1e6:.2f}s, "
-         f"{cps / 1e9:.3f} G cell-updates/s; population {int(final.sum())}")
+         f"{cps / 1e9:.3f} G cell-updates/s; population "
+         f"{int(final.sum()) if final is not None else 'n/a (multihost)'}")
     return 0
 
 

@@ -137,19 +137,23 @@ def load_snapshot(out_dir: str, name: str, iteration: int) -> np.ndarray:
     return assemble(out_dir, name, iteration)
 
 
+def remove_stale_tiles(out_dir: str, name: str, iteration: int, keep_pids) -> None:
+    """Remove tiles of other pids at this iteration — a resume that
+    rewrites an iteration with fewer writers must not leave old tiles
+    behind for ``assemble`` to silently merge.  Only valid when the caller
+    wrote ALL tiles of the iteration (single-host)."""
+    keep = set(keep_pids)
+    for pid in iteration_tile_pids(out_dir, name, iteration):
+        if pid not in keep:
+            os.remove(tile_path(out_dir, name, iteration, pid))
+
+
 def write_snapshot_tiles(
     out_dir: str, name: str, iteration: int,
     tiles: List[Tuple[np.ndarray, int, int]],
 ) -> None:
     """Write one iteration's snapshot as per-process tiles.
-    tiles: list of (tile_array, first_row, first_col), pid = list index.
-
-    Stale tiles from a previous run at the same (name, iteration) with a
-    larger writer count are removed — otherwise a resume that rewrites an
-    iteration with fewer writers would leave old tiles behind and
-    ``assemble`` would silently merge two runs' data."""
+    tiles: list of (tile_array, first_row, first_col), pid = list index."""
     for pid, (tile, r0, c0) in enumerate(tiles):
         write_tile(out_dir, name, iteration, pid, tile, r0, c0)
-    for pid in iteration_tile_pids(out_dir, name, iteration):
-        if pid >= len(tiles):
-            os.remove(tile_path(out_dir, name, iteration, pid))
+    remove_stale_tiles(out_dir, name, iteration, range(len(tiles)))

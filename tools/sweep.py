@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Scaling sweep harness — the automated form of the reference's manual
+run.sh/gol.pbs sweep workflow (accumulating one compact CSV across runs,
+first run writing the header; /root/reference/run.sh:4-5).
+
+Weak scaling: per-device tile size is fixed and the grid grows with the
+device count; efficiency = throughput(N devices) / (N * throughput(1)).
+
+    # real TPU (one chip visible -> single-row sweep)
+    python tools/sweep.py --steps 100 --tile 8192
+
+    # virtual 8-device CPU mesh (CI-style, like the reference's
+    # oversubscribed mpirun smoke runs)
+    python tools/sweep.py --virtual 8 --steps 10 --tile 64
+
+Outputs: sweep_compact.csv (reference 12-column schema) plus a JSON line
+per run with cells/sec and weak-scaling efficiency.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# --virtual N must take effect before jax import (and the axon
+# sitecustomize pins the platform via jax.config, so fix that too).
+def _virtual_count(argv):
+    for i, a in enumerate(argv):
+        if a == "--virtual":
+            if i + 1 >= len(argv) or not argv[i + 1].isdigit():
+                sys.exit("error: --virtual needs an integer device count")
+            return int(argv[i + 1])
+        if a.startswith("--virtual="):
+            val = a.split("=", 1)[1]
+            if not val.isdigit():
+                sys.exit("error: --virtual needs an integer device count")
+            return int(val)
+    return 0
+
+
+_VIRTUAL = _virtual_count(sys.argv[1:])
+if _VIRTUAL:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_VIRTUAL}"
+    ).strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+if _VIRTUAL:
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--virtual", type=int, default=0,
+                   help="use N virtual CPU devices instead of real chips")
+    p.add_argument("--tile", type=int, default=8192,
+                   help="per-device tile side (weak scaling keeps this fixed)")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--rule", default="life")
+    p.add_argument("--boundary", default="periodic")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--out-dir", default=".")
+    p.add_argument("--time-file", default="sweep")
+    args = p.parse_args(argv)
+
+    from mpi_tpu.models.rules import rule_from_name
+    from mpi_tpu.ops.bitlife import WORD
+    from mpi_tpu.parallel.mesh import make_mesh, choose_mesh_shape
+    from mpi_tpu.parallel.step import (
+        make_sharded_bit_stepper, make_sharded_stepper,
+        sharded_bit_init, sharded_init,
+    )
+    from mpi_tpu.utils.timing import PhaseTimer, write_reports
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    rule = rule_from_name(args.rule)
+    n_total = len(jax.devices())
+    counts = [n for n in (1, 2, 4, 8, 16, 32, 64, 128, 256) if n <= n_total]
+
+    base_cps = None
+    for i, n in enumerate(counts):
+        shape = choose_mesh_shape(n)
+        mesh = make_mesh(shape, devices=jax.devices()[:n])
+        rows, cols = shape[0] * args.tile, shape[1] * args.tile
+        packed = rule.radius == 1 and (cols // shape[1]) % WORD == 0
+
+        timer = PhaseTimer()
+        if packed:
+            grid = sharded_bit_init(mesh, rows, cols, args.seed)
+            evolve = make_sharded_bit_stepper(mesh, rule, args.boundary)
+        else:
+            grid = sharded_init(mesh, rows, cols, args.seed)
+            evolve = make_sharded_stepper(mesh, rule, args.boundary)
+        compiled = evolve.lower(grid, args.steps).compile()
+        jax.block_until_ready(grid)
+        timer.setup_done()
+        out = compiled(grid)
+        jax.block_until_ready(out)
+        timer.finish()
+
+        cps = timer.cells_per_sec(rows, cols, args.steps)
+        if base_cps is None:
+            base_cps = cps
+        eff = cps / (n * base_cps) if base_cps else 0.0
+        write_reports(args.time_file, timer, rows, cols, n,
+                      first=(i == 0), out_dir=args.out_dir)
+        print(json.dumps({
+            "devices": n, "mesh": list(shape), "grid": [rows, cols],
+            "steps": args.steps, "engine": "bitpacked" if packed else "dense",
+            "cells_per_sec": round(cps, 1),
+            "weak_scaling_efficiency": round(eff, 4),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,10 +5,10 @@ up/down rows and the six shifted word arrays in HBM each step, which
 makes it bandwidth-bound at large grids.  This kernel streams row blocks
 of the packed (H, W/32) uint32 grid through VMEM exactly as
 ``ops/pallas_stencil.py`` does for dense uint8 — same double-buffered
-halo-slab DMA scaffold — but the per-block compute is the SWAR adder
-tree of ``bitlife.bit_neighbor_bits``: all word shifts and lane rotations
-happen in registers, so HBM sees one packed read and one packed write per
-block (0.25 bytes per cell per step).
+halo-slab DMA scaffold — but the per-block compute is the SWAR carry-save
+adder + compiled rule of ``bitlife.column_sums``/``bit_next``: all word
+shifts and lane rotations happen in registers, so HBM sees one packed
+read and one packed write per block (0.25 bytes per cell per step).
 
 Periodic rows come from the modulo-wrapped slab DMAs; periodic columns
 from ``pltpu.roll`` lane rotation (the cross-word carry bits ride along
@@ -27,14 +27,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from mpi_tpu.models.rules import Rule, LIFE
-from mpi_tpu.ops.bitlife import WORD, bit_step_rows, packable
+from mpi_tpu.ops.bitlife import WORD, bit_next, column_sums, packable
 
 
 def _pick_block_rows(H: int, NW: int) -> int | None:
-    # 1 MiB per double-buffer slot: the SWAR compute keeps ~12 (BM, NW)
-    # u32 temporaries live, so the slot budget must leave most of the
-    # 16 MiB VMEM for them (2 MiB slots overflowed at NW=2048 by 28 KB).
-    budget = 1 << 20
+    # 2 MiB per double-buffer slot: the shared-sums compute keeps few
+    # enough (BM, NW) u32 temporaries live that 2 MiB slots now fit in
+    # the 16 MiB VMEM (measured: +4% at 65536^2 over 1 MiB; 4 MiB
+    # overflows).
+    budget = 2 << 20
     for bm in (512, 256, 128, 64, 32, 16, 8):
         if H % bm == 0 and (bm + 16) * NW * 4 <= budget:
             return bm
@@ -129,11 +130,14 @@ def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int):
                 return rolled
             return jnp.where(lane == NW - 1, jnp.uint32(0), rolled)
 
-        out_ref[:] = bit_step_rows(
-            up, mid, down,
-            prev_word(up), prev_word(mid), prev_word(down),
-            next_word(up), next_word(mid), next_word(down),
-            rule,
+        # vertical sums once; the left/right columns reuse the rolled sums
+        # (4 lane rotations instead of 6, no re-summing of shifted rows)
+        f0, f1, c0, c1 = column_sums(up, mid, down)
+        out_ref[:] = bit_next(
+            f0, f1, c0, c1,
+            prev_word(f0), prev_word(f1),
+            next_word(f0), next_word(f1),
+            mid, rule,
         )
 
     return kernel

@@ -66,7 +66,7 @@ def make_sharded_bit_stepper(mesh: Mesh, rule: Rule, boundary: str, axes=AXES):
     — one word column per side carries the cross-shard neighbor bits, the
     same ``ppermute`` pattern as the dense path but 32x fewer bytes per
     cell.  Radius-1 rules only (the packed adder tree is radius-1)."""
-    from mpi_tpu.ops.bitlife import bit_step_rows
+    from mpi_tpu.ops.bitlife import bit_next, column_sums
 
     if rule.radius != 1:
         raise ValueError("bitpacked sharded stepper supports radius-1 rules only")
@@ -76,12 +76,13 @@ def make_sharded_bit_stepper(mesh: Mesh, rule: Rule, boundary: str, axes=AXES):
     def local_step(local):
         h, nw = local.shape
         p = exchange_halo(local, 1, boundary, axes)  # (h+2, nw+2) words
-        up, mid, down = p[0:h, 1:-1], p[1 : h + 1, 1:-1], p[2 : h + 2, 1:-1]
-        return bit_step_rows(
-            up, mid, down,
-            p[0:h, 0:nw], p[1 : h + 1, 0:nw], p[2 : h + 2, 0:nw],
-            p[0:h, 2:], p[1 : h + 1, 2:], p[2 : h + 2, 2:],
-            rule,
+        # vertical column sums over the full padded width, once; the
+        # left/right neighbor-word sums are then just column slices
+        f0, f1, c0, c1 = column_sums(p[0:h], p[1 : h + 1], p[2 : h + 2])
+        return bit_next(
+            f0[:, 1:-1], f1[:, 1:-1], c0[:, 1:-1], c1[:, 1:-1],
+            f0[:, 0:nw], f1[:, 0:nw], f0[:, 2:], f1[:, 2:],
+            p[1 : h + 1, 1:-1], rule,
         )
 
     @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)

@@ -90,3 +90,43 @@ def test_pack_np_unpack_np_roundtrip():
     p = pack_np(g)
     np.testing.assert_array_equal(p, np.asarray(pack(jnp.asarray(g))))
     np.testing.assert_array_equal(unpack_np(p), g)
+
+
+def test_random_rules_parity():
+    """Fuzz the symmetric-function rule compiler: random B/S count sets
+    exercise run-merging, don't-care minimization, and every threshold
+    indicator — checked against the numpy oracle."""
+    from mpi_tpu.models.rules import Rule
+
+    rng = np.random.default_rng(42)
+    g = init_tile_np(32, 64, seed=9)
+    for i in range(25):
+        birth = frozenset(int(c) for c in rng.choice(9, rng.integers(0, 9), replace=False))
+        survive = frozenset(int(c) for c in rng.choice(9, rng.integers(0, 9), replace=False))
+        rule = Rule(f"fuzz{i}", birth, survive)
+        for boundary in ("periodic", "dead"):
+            out = np.asarray(unpack(bit_step(pack(jnp.asarray(g)), rule, boundary)))
+            np.testing.assert_array_equal(
+                out, step_np(g, rule, boundary),
+                err_msg=f"rule {rule} boundary {boundary}",
+            )
+
+
+def test_extreme_rules_parity():
+    """Edge rules: empty, full, B0 (strobing), count-8-only."""
+    from mpi_tpu.models.rules import Rule
+
+    g = init_tile_np(24, 64, seed=11)
+    cases = [
+        Rule("none", frozenset(), frozenset()),
+        Rule("all", frozenset(range(9)), frozenset(range(9))),
+        Rule("b0", frozenset({0}), frozenset()),
+        Rule("e8", frozenset({8}), frozenset({8})),
+    ]
+    for rule in cases:
+        for boundary in ("periodic", "dead"):
+            out = np.asarray(unpack(bit_step(pack(jnp.asarray(g)), rule, boundary)))
+            np.testing.assert_array_equal(
+                out, step_np(g, rule, boundary),
+                err_msg=f"rule {rule} boundary {boundary}",
+            )

@@ -1,0 +1,82 @@
+"""Microbenchmark: where does the SWAR Pallas kernel sit vs the chip roofs?
+
+Times the scanned pallas_bit_step over grid sizes (scalar popcount output
+forced to host, same methodology as bench.py — block_until_ready alone
+under-reports on the tunneled platform), reports cells/s and effective HBM
+bandwidth, plus an empirically measured uint32 VPU op roof.
+"""
+
+import functools
+import time
+
+import numpy as np
+
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.ops.bitlife import WORD, init_packed
+from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+
+
+def vpu_roof(jax, jnp, lax):
+    n_ops = 64
+    reps = 400
+    x = jnp.arange(8 * 1024 * 1024, dtype=jnp.uint32).reshape(2048, 4096)
+
+    @jax.jit
+    def chain(x):
+        def body(x, _):
+            for i in range(n_ops // 4):
+                x = (x ^ (x << jnp.uint32(1))) + (
+                    (x >> jnp.uint32(3)) | jnp.uint32(i + 1)
+                )
+            return x, None
+        x, _ = lax.scan(body, x, None, length=reps)
+        return jnp.sum(x >> jnp.uint32(24))
+
+    int(np.asarray(chain(x)))
+    t0 = time.perf_counter()
+    int(np.asarray(chain(x)))
+    dt = (time.perf_counter() - t0) / reps
+    return n_ops * x.size / dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print(f"devices: {jax.devices()}")
+    roof = vpu_roof(jax, jnp, lax)
+    print(f"VPU u32 roof (xor/shift/add chain): {roof/1e12:.2f} Tops/s")
+
+    @functools.partial(jax.jit, static_argnames=("steps",))
+    def evolve_pop(p, steps):
+        out, _ = lax.scan(
+            lambda x, _: (pallas_bit_step(x, LIFE, "periodic"), None),
+            p, None, length=steps,
+        )
+        return jnp.sum(lax.population_count(out).astype(jnp.uint32))
+
+    for side in (4096, 8192, 16384, 32768, 65536):
+        # enough steps that the ~70 ms tunnel round-trip is <2% of the call
+        steps = max(64, min(2048, int(2**31 / (side * side) * 64)))
+        packed = init_packed(side, side, seed=1)
+        int(np.asarray(evolve_pop(packed, steps)))  # compile + warm
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(np.asarray(evolve_pop(packed, steps)))
+            dt = (time.perf_counter() - t0) / steps
+            best = dt if best is None else min(best, dt)
+        cells = side * side
+        bw = 2 * cells / 8
+        print(
+            f"{side:6d}^2: {best*1e3:7.3f} ms/step  "
+            f"{cells/best/1e9:7.1f} Gcell/s  "
+            f"HBM {bw/best/1e9:6.1f} GB/s  "
+            f"(~90 ops/word -> {cells/WORD*90/best/1e12:.2f} Tops/s)"
+        )
+        del packed
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,10 @@ GRID=${GRID:-65536}
 ITERS=${ITERS:-1000}
 GAP=${GAP:-250}
 SEED=${SEED:-1}
+# Snapshots default ON (SAVE=0 disables): without --save the run would
+# produce no grid output at all on a multihost slice, where run_tpu
+# returns no final grid to the driver process.
+SAVE=${SAVE:-1}
 
 # MULTIHOST=1 joins the slice-wide process group (set it when launching on
 # every host of a pod slice; leave unset for single-host runs).  The run
@@ -27,6 +31,9 @@ SEED=${SEED:-1}
 # rather than per-host timestamps.
 NAME=${NAME:-batch-${GRID}x${GRID}-${ITERS}-s${SEED}}
 
+SAVE_FLAG=--save
+[ "$SAVE" = 0 ] && SAVE_FLAG=
+
 python -m mpi_tpu.cli "$GRID" "$GRID" "$GAP" "$ITERS" batch_timings "${FIRST:-1}" \
-  --backend tpu --seed "$SEED" --name "$NAME" ${SAVE:+--save} \
+  --backend tpu --seed "$SEED" --name "$NAME" $SAVE_FLAG \
   ${MULTIHOST:+--multihost} --out-dir "${OUT_DIR:-.}"

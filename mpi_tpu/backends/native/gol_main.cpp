@@ -15,6 +15,7 @@
 // (main.cpp:356-363) with correctly-labeled microseconds.
 
 #include <chrono>
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -99,10 +100,26 @@ int main(int argc, char** argv) {
             }
             return argv[++i];
         };
-        if (a == "--workers") workers = std::stoi(next("--workers"));
+        auto parse_int = [&](const char* flag, const std::string& v,
+                             long lo, long hi) -> long {
+            try {
+                size_t used = 0;
+                long out = std::stol(v, &used);
+                if (used != v.size()) throw std::invalid_argument(v);
+                if (out < lo || out > hi) throw std::out_of_range(v);
+                return out;
+            } catch (const std::exception&) {
+                std::fprintf(stderr, "%s: invalid integer '%s' (range %ld..%ld)\n",
+                             flag, v.c_str(), lo, hi);
+                exit(2);
+            }
+        };
+        if (a == "--workers")
+            workers = (int)parse_int("--workers", next("--workers"), 1, INT_MAX);
         else if (a == "--boundary") boundary = next("--boundary");
         else if (a == "--rule") rule_name = next("--rule");
-        else if (a == "--seed") seed = (uint32_t)std::stoul(next("--seed"));
+        else if (a == "--seed")
+            seed = (uint32_t)parse_int("--seed", next("--seed"), 0, (long)UINT32_MAX);
         else if (a == "--out-dir") out_dir = next("--out-dir");
         else if (a == "--name") name = next("--name");
         else if (a == "--save") save = true;

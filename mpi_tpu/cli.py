@@ -108,6 +108,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _run(args) -> int:
+    if args.resume and args.multihost:
+        # Multihost snapshots are sharded per host (each host's disk holds
+        # only its addressable shards), so no host can assemble the full
+        # grid, and device_put of a host-global array onto a sharding
+        # spanning non-addressable devices is invalid anyway.  Check
+        # before jax.distributed.initialize() so the error is immediate.
+        raise ConfigError(
+            "--resume is not supported with --multihost: snapshots are "
+            "sharded per host; assemble the tiles offline and restart "
+            "single-host, or rerun from scratch"
+        )
     if args.multihost:
         # must precede any other jax usage (the backend reads the process
         # group at initialization; the reference's MPI_Init analog)
@@ -212,12 +223,16 @@ def _run(args) -> int:
             # writes only its addressable shards)
             for pid, tile, r0, c0 in tiles:
                 golio.write_tile(args.out_dir, name, iteration, pid, tile, r0, c0)
-            import jax
-
-            if jax.process_count() == 1:
-                golio.remove_stale_tiles(
-                    args.out_dir, name, iteration, [t[0] for t in tiles]
-                )
+            # Every host prunes tiles whose pid is not in the CURRENT
+            # global writer set: a rerun of the same config-derived name
+            # with fewer writers must not leave old tiles for assemble to
+            # merge.  Stale pids in the current set are simply overwritten
+            # by their owner; dead pids are safe to remove from any host
+            # (per-host local disks each see only their own leftovers, and
+            # remove_stale_tiles tolerates shared-filesystem races).
+            golio.remove_stale_tiles(
+                args.out_dir, name, iteration, range(processes)
+            )
 
         profile_ctx = contextlib.nullcontext()
         if args.profile:

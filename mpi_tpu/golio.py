@@ -138,14 +138,19 @@ def load_snapshot(out_dir: str, name: str, iteration: int) -> np.ndarray:
 
 
 def remove_stale_tiles(out_dir: str, name: str, iteration: int, keep_pids) -> None:
-    """Remove tiles of other pids at this iteration — a resume that
-    rewrites an iteration with fewer writers must not leave old tiles
-    behind for ``assemble`` to silently merge.  Only valid when the caller
-    wrote ALL tiles of the iteration (single-host)."""
+    """Remove tiles of pids outside ``keep_pids`` at this iteration — a
+    rerun/resume that rewrites an iteration with fewer writers must not
+    leave old tiles behind for ``assemble`` to silently merge.  keep_pids
+    must be the set of ALL pids current writers will produce (across every
+    host, in multihost runs); concurrent removal by several hosts on a
+    shared filesystem is tolerated."""
     keep = set(keep_pids)
     for pid in iteration_tile_pids(out_dir, name, iteration):
         if pid not in keep:
-            os.remove(tile_path(out_dir, name, iteration, pid))
+            try:
+                os.remove(tile_path(out_dir, name, iteration, pid))
+            except FileNotFoundError:
+                pass  # another host already removed it
 
 
 def write_snapshot_tiles(

@@ -129,3 +129,43 @@ def test_visualizer_ascii_and_gif(tmp_path, capsys):
     gif = os.path.join(str(tmp_path), "viz.gif")
     assert viz.main([master, "--format", "gif", "--out", gif]) == 0
     assert os.path.getsize(gif) > 0
+
+
+def test_cli_resume_multihost_rejected(tmp_path, capsys):
+    rc = main([
+        "32", "32", "8", "16", "--backend", "tpu",
+        "--out-dir", str(tmp_path), "--resume", "x@8", "--multihost",
+        "--quiet",
+    ])
+    assert rc == 2
+    assert "multihost" in capsys.readouterr().err
+
+
+def test_cli_rerun_fewer_writers_prunes_stale_tiles(tmp_path):
+    """A rerun of the same name with fewer tile writers must remove the
+    old writers' tiles, or assemble would silently merge two runs."""
+    run_cli(tmp_path, "rr", "tpu", extra=("--mesh", "2x4"))
+    pids = golio.iteration_tile_pids(str(tmp_path), "rr", 16)
+    assert len(pids) == 8
+    run_cli(tmp_path, "rr", "tpu", extra=("--mesh", "1x2"))
+    pids = golio.iteration_tile_pids(str(tmp_path), "rr", 16)
+    assert len(pids) == 2
+    # and the snapshot still assembles to the oracle grid
+    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(golio.load_snapshot(str(tmp_path), "rr", 16), ref)
+
+
+def test_native_malformed_flag_exits_cleanly():
+    import subprocess
+
+    exe = os.path.join(
+        os.path.dirname(__file__), "..", "mpi_tpu", "backends", "native", "gol_native"
+    )
+    if not os.path.exists(exe):
+        pytest.skip("native binary not built")
+    r = subprocess.run(
+        [exe, "8", "8", "1", "1", "--workers", "abc"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 2
+    assert "invalid integer" in r.stderr

@@ -82,7 +82,10 @@ def main(argv=None) -> int:
     os.makedirs(args.out_dir, exist_ok=True)
     rule = rule_from_name(args.rule)
     n_total = len(jax.devices())
-    counts = [n for n in (1, 2, 4, 8, 16, 32, 64, 128, 256) if n <= n_total]
+    # powers of two up to the machine, plus the full machine itself (a
+    # 6- or 12-device topology must still get its full-size data point)
+    counts = sorted({n for n in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+                     if n <= n_total} | {n_total})
 
     base_cps = None
     for i, n in enumerate(counts):

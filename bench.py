@@ -6,9 +6,10 @@ north-star grid (65536², the BASELINE.json weak-scaling config) — the
 reference's derived throughput metric (cells/sec = gszI·gszJ·nIter /
 t_nosetup, /root/reference/main.cpp:337-347) measured the XLA way: the
 whole multi-step evolution is one compiled scan over the fused Pallas
-SWAR kernel (ops/pallas_bitlife.py, 32 cells per uint32 lane), with a
-scalar popcount reduction as output so timing excludes host transfer of
-the grid (the device<->host tunnel is slow and would otherwise dominate;
+SWAR kernel (ops/pallas_bitlife.py, 32 cells per uint32 lane) running
+GENS temporally-blocked generations per HBM round-trip, with a scalar
+popcount reduction as output so timing excludes host transfer of the
+grid (the device<->host tunnel is slow and would otherwise dominate;
 block_until_ready alone under-reports on this platform).
 
 vs_baseline: ratio to the north star's per-chip share — BASELINE.json
@@ -22,7 +23,9 @@ import time
 import numpy as np
 
 SIZE = 65536
-STEPS = 50
+STEPS = 48
+GENS = 8  # temporally-blocked generations per kernel pass
+assert STEPS % GENS == 0, "throughput formula assumes STEPS exact in GENS"
 BASELINE_PER_CHIP = 1e11 / 64
 
 
@@ -35,13 +38,13 @@ def main() -> None:
     from mpi_tpu.ops.bitlife import init_packed
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, supports
 
-    assert supports((SIZE, SIZE), LIFE)
+    assert supports((SIZE, SIZE), LIFE, gens=GENS)
 
     @functools.partial(jax.jit, static_argnames=("steps",))
     def evolve_pop(p, steps):
         out, _ = lax.scan(
-            lambda x, _: (pallas_bit_step(x, LIFE, "periodic"), None),
-            p, None, length=steps,
+            lambda x, _: (pallas_bit_step(x, LIFE, "periodic", gens=GENS), None),
+            p, None, length=steps // GENS,
         )
         # popcount over packed words -> scalar (4-byte host fetch)
         return jnp.sum(lax.population_count(out).astype(jnp.uint32))

@@ -75,7 +75,10 @@ def run_tpu(
     # Auto-chosen meshes must pass the same compatibility checks as
     # explicit --mesh shapes (fail fast, not deep in shard_map).
     mi, mj = mesh.shape[AXES[0]], mesh.shape[AXES[1]]
-    validate_mesh(config.rows, config.cols, (mi, mj), config.rule.radius)
+    validate_mesh(
+        config.rows, config.cols, (mi, mj),
+        config.rule.radius * config.comm_every,
+    )
 
     # Engine choice: bitpacked SWAR (32 cells/lane) for radius-1 rules when
     # every shard's width packs into whole uint32 words; dense uint8 else.
@@ -87,13 +90,19 @@ def run_tpu(
             make_sharded_bit_stepper, sharded_bit_init, make_sharded_unpacker,
         )
 
-        evolve = make_sharded_bit_stepper(mesh, config.rule, config.boundary)
+        evolve = make_sharded_bit_stepper(
+            mesh, config.rule, config.boundary,
+            gens_per_exchange=config.comm_every,
+        )
         if initial is not None:
             grid = jax.device_put(pack_np(initial), grid_sharding(mesh))
         else:
             grid = sharded_bit_init(mesh, config.rows, config.cols, config.seed)
     else:
-        evolve = make_sharded_stepper(mesh, config.rule, config.boundary)
+        evolve = make_sharded_stepper(
+            mesh, config.rule, config.boundary,
+            gens_per_exchange=config.comm_every,
+        )
         if initial is not None:
             grid = jax.device_put(np.asarray(initial, dtype=np.uint8), grid_sharding(mesh))
         else:

@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TPU device mesh shape, e.g. 2x4 (default: auto)")
     p.add_argument("--workers", type=int, default=0,
                    help="cpp-par worker threads (default: auto)")
+    p.add_argument("--comm-every", type=int, default=1, metavar="K",
+                   help="tpu backend: generations per halo exchange (1..8). "
+                   "K > 1 exchanges a K-deep ghost ring and runs K local "
+                   "generations between collectives (communication-avoiding; "
+                   "the deep-halo optimization the reference's per-step "
+                   "barrier+exchange loop leaves out, main.cpp:291-305)")
     p.add_argument("--name", default=None, help="run name (default: timestamp)")
     p.add_argument("--strict", action="store_true",
                    help="enforce the reference's validation rules "
@@ -142,6 +148,7 @@ def _run(args) -> int:
         mesh_shape=mesh_shape,
         out_dir=args.out_dir,
         workers=args.workers,
+        comm_every=args.comm_every,
     )
     if args.strict:
         config.validate_strict()

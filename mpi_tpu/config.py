@@ -32,22 +32,31 @@ class ConfigError(ValueError):
     ``MPI_Abort`` on bad args, ``main.cpp:176,189,197``)."""
 
 
-def validate_mesh(rows: int, cols: int, mesh_shape: Tuple[int, int], radius: int) -> None:
-    """Grid/mesh compatibility: divisibility and minimum tile size.  Called
-    both for explicit ``--mesh`` shapes and for auto-chosen device meshes
-    (the TPU runner validates after choosing), so every path fails fast with
-    a named error instead of a deep shard_map trace error."""
+def validate_mesh(rows: int, cols: int, mesh_shape: Tuple[int, int], ghost: int) -> None:
+    """Grid/mesh compatibility: divisibility and minimum tile size for a
+    ``ghost``-deep halo (= rule radius × comm_every).  Called both for
+    explicit ``--mesh`` shapes and for auto-chosen device meshes (the TPU
+    runner validates after choosing), so every path fails fast with a named
+    error instead of a deep shard_map trace error."""
     mi, mj = mesh_shape
     if mi < 1 or mj < 1:
         raise ConfigError(f"mesh_shape must be positive, got {mesh_shape}")
     if rows % mi or cols % mj:
         raise ConfigError(f"mesh {mesh_shape} does not divide grid {rows}x{cols}")
     tile_r, tile_c = rows // mi, cols // mj
-    min_tile = 2 * radius + 2
+    min_tile = 2 * ghost + 2
+    hint = "rule radius x comm_every"
     if (mi > 1 and tile_r < min_tile) or (mj > 1 and tile_c < min_tile):
         raise ConfigError(
-            f"tile {tile_r}x{tile_c} too small for radius {radius} "
-            f"halo (need >= {min_tile} per sharded axis)"
+            f"tile {tile_r}x{tile_c} too small for a {ghost}-deep halo "
+            f"({hint}; need >= {min_tile} per sharded axis)"
+        )
+    if tile_r < ghost or tile_c < ghost:
+        # even a 1-shard axis slices a ghost-deep ring off the tile
+        # (self-wrap / zero fill) — a smaller tile would silently truncate
+        raise ConfigError(
+            f"tile {tile_r}x{tile_c} smaller than the {ghost}-deep ghost "
+            f"ring ({hint})"
         )
 
 
@@ -64,6 +73,7 @@ class GolConfig:
     mesh_shape: Optional[Tuple[int, int]] = None  # device mesh (rows_axis, cols_axis); None = auto
     out_dir: str = "."
     workers: int = 0                 # native backend threads; 0 = auto
+    comm_every: int = 1              # TPU: generations per halo exchange (1..8)
 
     def __post_init__(self):
         if self.rows <= 0 or self.cols <= 0:
@@ -78,8 +88,22 @@ class GolConfig:
             raise ConfigError(
                 f"backend must be one of tpu/serial/cpp/cpp-par, got {self.backend!r}"
             )
-        if self.mesh_shape is not None:
-            validate_mesh(self.rows, self.cols, self.mesh_shape, self.rule.radius)
+        if not 1 <= self.comm_every <= 8:
+            raise ConfigError(f"comm_every must be in 1..8, got {self.comm_every}")
+        if self.comm_every > 1 and self.backend != "tpu":
+            raise ConfigError(
+                f"comm_every applies to the tpu backend only "
+                f"(got backend={self.backend!r})"
+            )
+        if self.comm_every > 1 and 0 in self.rule.birth:
+            raise ConfigError("comm_every > 1 requires a rule without birth-on-0")
+        if self.mesh_shape is not None and self.backend == "tpu":
+            # only the tpu backend shards over the mesh / slices ghost
+            # rings; other backends ignore mesh_shape entirely
+            validate_mesh(
+                self.rows, self.cols, self.mesh_shape,
+                self.rule.radius * self.comm_every,
+            )
 
     def validate_strict(self) -> None:
         """Enforce the reference's exact preconditions (``main.cpp:195``):

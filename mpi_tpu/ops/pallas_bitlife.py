@@ -14,6 +14,17 @@ Periodic rows come from the modulo-wrapped slab DMAs; periodic columns
 from ``pltpu.roll`` lane rotation (the cross-word carry bits ride along
 inside the rotated words).  Dead boundary: edge slabs zeroed, rotated
 edge words masked with a lane iota.
+
+Temporal blocking (``gens`` > 1): the 8-row DMA-alignment halo is deeper
+than the rule's radius-1 needs, so after one HBM round-trip the slab can
+be stepped up to 8 generations in VMEM — each generation shrinks the
+valid row window by one from each side, and after ``gens`` generations
+the middle BM rows are exactly ``gens`` steps ahead.  Neighboring blocks
+recompute each other's halo rows redundantly from the same input (the
+classic overlapped/trapezoidal stencil tiling), so blocks stay
+independent.  HBM traffic drops by ``gens``× for ~(2·gens/BM) extra
+compute; on chips where the kernel is bandwidth- or latency-bound this
+is the difference between ~30% and ~100% VPU occupancy.
 """
 
 from __future__ import annotations
@@ -30,33 +41,79 @@ from mpi_tpu.models.rules import Rule, LIFE
 from mpi_tpu.ops.bitlife import WORD, bit_next, column_sums, packable
 
 
-def _pick_block_rows(H: int, NW: int) -> int | None:
-    # 2 MiB per double-buffer slot: the shared-sums compute keeps few
-    # enough (BM, NW) u32 temporaries live that 2 MiB slots now fit in
-    # the 16 MiB VMEM (measured: +4% at 65536^2 over 1 MiB; 4 MiB
-    # overflows).
-    budget = 2 << 20
-    for bm in (512, 256, 128, 64, 32, 16, 8):
-        if H % bm == 0 and (bm + 16) * NW * 4 <= budget:
-            return bm
+def _pick_blocks(H: int, NW: int, gens: int = 1) -> tuple[int, int] | None:
+    """(BM, CM): DMA-slab rows and compute-tile rows.
+
+    BM bounds the double-buffered HBM↔VMEM slabs — bigger is better (DMA
+    amortization, and with temporal blocking the whole slab is reused for
+    ``gens`` generations).  CM bounds the live compute temporaries: each
+    generation is evaluated over sub-tiles of CM rows, so the working set
+    is ~13.5 live (rows, NW) u32 arrays for single-tile windows and ~16
+    for sub-tiled ones (the saved-row carry and concat add live copies) —
+    calibrated against Mosaic's scoped-vmem accounting ((BM=128, NW=2048,
+    gens=4) single-tile reports 16.29M over the 16M limit and (BM=512,
+    CM=64, NW=2048, gens=1) reports 16.25M, both rejected; (BM=512,
+    CM=256, NW=512, gens=8) and (BM=64, single-tile, NW=2048, gens=8)
+    compile and are kept).
+
+    Wide rows (NW > 512) use single-tile windows only: sub-tiled kernels
+    there hit pathological Mosaic compile times (a (256, 64) kernel at
+    NW=2048 did not finish compiling in 9 minutes, while single-tile
+    variants compile in ~1-2).  Narrow rows prefer the largest CM first —
+    big compute tiles both run fastest (measured: (512, 256) at NW=512
+    beats every (·, ≤64) shape) and bound the unrolled sub-tile count —
+    then the largest slab BM that still fits."""
+    sizes = (512, 256, 128, 64, 32, 16, 8)
+    if NW > 512:
+        limit = int(15.75 * (1 << 20))
+        for bm in sizes:
+            if H % bm:
+                continue
+            dbuf = 2 * (bm + 16) * NW * 4
+            temps = 13.5 * (bm + 2 * gens + 2) * NW * 4
+            if dbuf + temps <= limit:
+                # CM = BM + 16 ≥ BM + 2·(gens−1): every window single-tile
+                return bm, bm + 16
+        return None
+    limit = int(15.25 * (1 << 20))
+    for cm in sizes:
+        room = limit - 16 * (cm + 2 * gens + 2) * NW * 4
+        if room <= 0:
+            continue
+        for bm in sizes:
+            if bm < cm or H % bm:
+                continue
+            if 2 * (bm + 16) * NW * 4 <= room:
+                return bm, cm
     return None
 
 
-def supports(shape, rule: Rule) -> bool:
-    """(H, W) cell-space shapes this kernel handles."""
+def _pick_block_rows(H: int, NW: int, gens: int = 1) -> int | None:
+    picked = _pick_blocks(H, NW, gens)
+    return picked[0] if picked else None
+
+
+def supports(shape, rule: Rule, gens: int = 1) -> bool:
+    """(H, W) cell-space shapes this kernel handles at the given temporal
+    blocking depth (deeper gens need more VMEM, so query with the gens you
+    will run)."""
     H, W = shape
     return (
         packable(shape, rule)
         and (W // WORD) % 128 == 0  # packed width must stay lane-aligned
         and H >= 8
-        and _pick_block_rows(H, W // WORD) is not None
+        and _pick_block_rows(H, W // WORD, gens) is not None
     )
 
 
-def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int):
+def _make_kernel(
+    rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int, gens: int = 1
+):
     periodic = boundary == "periodic"
     nblocks = H // BM
     HALO = 8  # DMA row slices must be 8-sublane aligned; radius is 1
+    if not 1 <= gens <= HALO:
+        raise ValueError(f"gens must be in 1..{HALO}, got {gens}")
 
     def _block_dmas(in_hbm, dbuf, sems, blk, slot):
         base = blk * BM
@@ -101,44 +158,99 @@ def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int):
         scratch = dbuf.at[slot]
 
         if not periodic:
+            # Zero the whole 8-row edge slabs: rows beyond the grid are dead,
+            # and (absent birth-on-0) they stay dead through every in-VMEM
+            # generation, so the multi-gen loop needs no re-masking.
             @pl.when(i == 0)
             def _():
-                scratch[HALO - 1 : HALO, :] = jnp.zeros((1, NW), dtype=jnp.uint32)
+                scratch[0:HALO, :] = jnp.zeros((HALO, NW), dtype=jnp.uint32)
 
             @pl.when(i == nblocks - 1)
             def _():
-                scratch[HALO + BM : HALO + BM + 1, :] = jnp.zeros((1, NW), dtype=jnp.uint32)
+                scratch[HALO + BM : HALO + BM + HALO, :] = jnp.zeros(
+                    (HALO, NW), dtype=jnp.uint32
+                )
 
-        lane = (
-            None if periodic
-            else lax.broadcasted_iota(jnp.int32, (BM, NW), dimension=1)
-        )
+        def sub_gen(up, mid, down, rows):
+            """Next state of mid given its row neighbors."""
+            lane = (
+                None if periodic
+                else lax.broadcasted_iota(jnp.int32, (rows, NW), dimension=1)
+            )
 
-        up = scratch[HALO - 1 : HALO - 1 + BM, :]
-        mid = scratch[HALO : HALO + BM, :]
-        down = scratch[HALO + 1 : HALO + 1 + BM, :]
+            def prev_word(x):
+                rolled = pltpu.roll(x, 1, axis=1)
+                if periodic:
+                    return rolled
+                return jnp.where(lane == 0, jnp.uint32(0), rolled)
 
-        def prev_word(x):
-            rolled = pltpu.roll(x, 1, axis=1)
-            if periodic:
-                return rolled
-            return jnp.where(lane == 0, jnp.uint32(0), rolled)
+            def next_word(x):
+                rolled = pltpu.roll(x, NW - 1, axis=1)
+                if periodic:
+                    return rolled
+                return jnp.where(lane == NW - 1, jnp.uint32(0), rolled)
 
-        def next_word(x):
-            rolled = pltpu.roll(x, NW - 1, axis=1)
-            if periodic:
-                return rolled
-            return jnp.where(lane == NW - 1, jnp.uint32(0), rolled)
+            # vertical sums once; the left/right columns reuse the rolled
+            # sums (4 lane rotations instead of 6, no re-summing of rows)
+            f0, f1, c0, c1 = column_sums(up, mid, down)
+            return bit_next(
+                f0, f1, c0, c1,
+                prev_word(f0), prev_word(f1),
+                next_word(f0), next_word(f1),
+                mid, rule,
+            )
 
-        # vertical sums once; the left/right columns reuse the rolled sums
-        # (4 lane rotations instead of 6, no re-summing of shifted rows)
-        f0, f1, c0, c1 = column_sums(up, mid, down)
-        out_ref[:] = bit_next(
-            f0, f1, c0, c1,
-            prev_word(f0), prev_word(f1),
-            next_word(f0), next_word(f1),
-            mid, rule,
-        )
+        # Each generation consumes one valid row from each side of the slab;
+        # only rows that later generations (or the output block) still need
+        # are recomputed.  Within a generation the row window is evaluated
+        # in CM-row sub-tiles to bound live VMEM temporaries; the update is
+        # in place, so each sub-tile's top neighbor row (overwritten by the
+        # previous sub-tile) is carried in ``saved``.  All bounds are Python
+        # ints — fully static.
+        lo, hi = 0, BM + 2 * HALO
+        for g in range(gens):
+            rem = gens - 1 - g  # generations still to run after this one
+            glo = max(lo + 1, HALO - rem)
+            ghi = min(hi - 1, HALO + BM + rem)
+            saved = None
+            a = glo
+            while a < ghi:
+                b = min(a + CM, ghi)
+                rows = b - a
+                top = scratch[a - 1 : a, :] if saved is None else saved
+                if rows > 1:
+                    up = jnp.concatenate([top, scratch[a : b - 1, :]], axis=0)
+                else:
+                    up = top
+                mid = scratch[a:b, :]
+                down = scratch[a + 1 : b + 1, :]
+                if rem:
+                    saved = scratch[b - 1 : b, :]  # old value, read before write
+                new = sub_gen(up, mid, down, rows)
+                if rem:
+                    scratch[a:b, :] = new
+                else:
+                    out_ref[a - HALO : b - HALO, :] = new
+                a = b
+            if rem:
+                if not periodic:
+                    # Rows beyond the grid edge are not real cells: live grid
+                    # neighbors would "give birth" into them — re-kill them
+                    # after every in-VMEM generation at the edge blocks.
+                    if glo < HALO:
+                        @pl.when(i == 0)
+                        def _():
+                            scratch[glo:HALO, :] = jnp.zeros(
+                                (HALO - glo, NW), dtype=jnp.uint32
+                            )
+
+                    if ghi > HALO + BM:
+                        @pl.when(i == nblocks - 1)
+                        def _():
+                            scratch[HALO + BM : ghi, :] = jnp.zeros(
+                                (ghi - HALO - BM, NW), dtype=jnp.uint32
+                            )
+                lo, hi = glo, ghi
 
     return kernel
 
@@ -148,14 +260,22 @@ def pallas_bit_step(
     rule: Rule = LIFE,
     boundary: str = "periodic",
     interpret: bool = False,
+    gens: int = 1,
+    blocks: tuple[int, int] | None = None,
 ) -> jax.Array:
-    """One generation on a packed (H, W/32) uint32 grid via the fused
-    SWAR kernel.  Requires ``supports((H, W), rule)``."""
+    """``gens`` generations (default one) on a packed (H, W/32) uint32 grid
+    via the fused SWAR kernel, in a single HBM round-trip.  Requires
+    ``supports((H, W), rule)`` and ``gens <= 8``.  ``blocks`` overrides the
+    auto-picked (BM, CM) DMA-slab/compute-tile rows (tests)."""
     H, NW = packed.shape
-    BM = _pick_block_rows(H, NW)
-    if rule.radius != 1 or BM is None:
+    picked = blocks or _pick_blocks(H, NW, gens)
+    if rule.radius != 1 or picked is None:
         raise ValueError(f"pallas_bit_step cannot handle packed shape {packed.shape}")
-    kernel = _make_kernel(rule, boundary, H, NW, BM)
+    if gens > 1 and 0 in rule.birth:
+        # dead-boundary halo rows must stay dead across in-VMEM generations
+        raise ValueError("gens > 1 requires a rule without birth-on-0")
+    BM, CM = picked
+    kernel = _make_kernel(rule, boundary, H, NW, BM, CM, gens)
     return pl.pallas_call(
         kernel,
         grid=(H // BM,),
@@ -171,22 +291,33 @@ def pallas_bit_step(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("rule", "boundary", "steps", "interpret"), donate_argnums=0
+    jax.jit,
+    static_argnames=("rule", "boundary", "steps", "interpret", "gens"),
+    donate_argnums=0,
 )
-def _evolve_bits_pallas(packed, rule, boundary, steps, interpret):
-    def body(p, _):
-        return pallas_bit_step(p, rule, boundary, interpret=interpret), None
+def _evolve_bits_pallas(packed, rule, boundary, steps, interpret, gens=1):
+    gens = max(1, min(gens, steps))
 
-    out, _ = lax.scan(body, packed, None, length=steps)
+    def body(p, _):
+        return pallas_bit_step(p, rule, boundary, interpret=interpret, gens=gens), None
+
+    full, rem = divmod(steps, gens)
+    out, _ = lax.scan(body, packed, None, length=full)
+    if rem:
+        out = pallas_bit_step(out, rule, boundary, interpret=interpret, gens=rem)
     return out
 
 
 def make_pallas_bit_stepper(
-    rule: Rule = LIFE, boundary: str = "periodic", interpret: bool = False
+    rule: Rule = LIFE,
+    boundary: str = "periodic",
+    interpret: bool = False,
+    gens: int = 1,
 ):
-    """evolve(packed, steps) on packed uint32 grids."""
+    """evolve(packed, steps) on packed uint32 grids, running ``gens``
+    generations per kernel pass (temporal blocking)."""
 
     def evolve(packed: jax.Array, steps: int) -> jax.Array:
-        return _evolve_bits_pallas(packed, rule, boundary, steps, interpret)
+        return _evolve_bits_pallas(packed, rule, boundary, steps, interpret, gens)
 
     return evolve

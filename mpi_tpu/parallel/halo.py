@@ -63,6 +63,14 @@ def exchange_halo(local, radius: int, boundary: str, axes=AXES):
     """(h, w) shard → (h+2r, w+2r) with ghost ring filled.  Must be called
     inside ``shard_map`` over a mesh with the given axis names.  Rows phase
     then columns phase on the row-extended array → corners correct."""
+    return exchange_halo_rc(local, radius, radius, boundary, axes)
+
+
+def exchange_halo_rc(local, radius_rows: int, radius_cols: int, boundary: str,
+                     axes=AXES):
+    """``exchange_halo`` with independent row/column ghost depths — the
+    bitpacked stepper exchanges K ghost rows but a single ghost *word*
+    column (32 halo bits cover any K ≤ 8)."""
     periodic = boundary == "periodic"
-    x = _axis_exchange(local, axes[0], 0, radius, periodic)
-    return _axis_exchange(x, axes[1], 1, radius, periodic)
+    x = _axis_exchange(local, axes[0], 0, radius_rows, periodic)
+    return _axis_exchange(x, axes[1], 1, radius_cols, periodic)

@@ -33,67 +33,159 @@ def grid_sharding(mesh: Mesh, axes=AXES) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*axes))
 
 
-def make_sharded_stepper(mesh: Mesh, rule: Rule, boundary: str, axes=AXES):
+def _kill_outside_global(x, axes, margins):
+    """Zero cells of x that lie outside the global grid: the (top, bottom,
+    left, right) ``margins`` are ghost-deep fringes that only extend past the
+    grid on the shards at the corresponding mesh edge (dead boundary)."""
+    top, bottom, left, right = margins
+    h, w = x.shape[0], x.shape[1]
+    zero = jnp.zeros((), dtype=x.dtype)
+    ri = lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    ci = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    i0 = lax.axis_index(axes[0])
+    j0 = lax.axis_index(axes[1])
+    ni = lax.axis_size(axes[0])
+    nj = lax.axis_size(axes[1])
+    if top:
+        x = jnp.where((i0 == 0) & (ri < top), zero, x)
+    if bottom:
+        x = jnp.where((i0 == ni - 1) & (ri >= h - bottom), zero, x)
+    if left:
+        x = jnp.where((j0 == 0) & (ci < left), zero, x)
+    if right:
+        x = jnp.where((j0 == nj - 1) & (ci >= w - right), zero, x)
+    return x
+
+
+def make_sharded_stepper(
+    mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1
+):
     """Returns evolve(grid, steps) running shard-parallel over the mesh.
 
     grid must be (rows, cols) uint8, rows % mesh[axes[0]] == 0 and
     cols % mesh[axes[1]] == 0; output keeps the same sharding.
-    """
-    spec = PartitionSpec(*axes)
 
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=spec, out_specs=spec
-    )
-    def local_step(local):
-        padded = exchange_halo(local, rule.radius, boundary, axes)
-        counts = counts_from_padded(padded, rule.radius)
-        return apply_rule(local, counts, rule)
+    ``gens_per_exchange`` = K > 1 turns on communication-avoiding deep
+    halos: one K·r-deep ghost exchange feeds K local generations, shrinking
+    the valid fringe by r each generation (the redundant fringe compute is
+    the price for 1/K as many collectives — the right trade when the
+    ppermute rides DCN or the per-collective latency dominates, exactly the
+    overlap the reference leaves on the table with its per-step barrier,
+    ``/root/reference/main.cpp:297``).
+    """
+    K = gens_per_exchange
+    r = rule.radius
+    if K < 1:
+        raise ValueError(f"gens_per_exchange must be >= 1, got {K}")
+    if K > 1 and 0 in rule.birth:
+        raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
+    spec = PartitionSpec(*axes)
+    dead = boundary != "periodic"
+
+    def make_local(k):
+        @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+        def local_step(local):
+            padded = exchange_halo(local, k * r, boundary, axes)
+            for g in range(k):
+                mid = padded[r:-r, r:-r]
+                counts = counts_from_padded(padded, r)
+                padded = apply_rule(mid, counts, rule)
+                fringe = (k - 1 - g) * r
+                if dead and fringe:
+                    # fringe cells outside the global grid are not real
+                    # cells; re-kill any "born" from live grid neighbors
+                    padded = _kill_outside_global(
+                        padded, axes, (fringe,) * 4
+                    )
+            return padded
+
+        return local_step
+
+    return _segmented_evolve(make_local, K)
+
+
+def _segmented_evolve(make_local, K):
+    """evolve(grid, steps): scan ``steps // K`` K-generation exchanges plus
+    a single (steps % K)-generation remainder exchange."""
 
     @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
     def evolve(grid, steps: int):
-        def body(g, _):
-            return local_step(g), None
+        k = max(1, min(K, steps))  # short segments: skip tracing unused depth
+        full, rem = divmod(steps, k)
+        if full:
+            step_k = make_local(k)
 
-        out, _ = lax.scan(body, grid, None, length=steps)
-        return out
+            def body(g, _):
+                return step_k(g), None
+
+            grid, _ = lax.scan(body, grid, None, length=full)
+        if rem:
+            grid = make_local(rem)(grid)
+        return grid
 
     return evolve
 
 
-def make_sharded_bit_stepper(mesh: Mesh, rule: Rule, boundary: str, axes=AXES):
+def make_sharded_bit_stepper(
+    mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1
+):
     """Bitpacked (SWAR) shard-parallel evolution: grids are (rows, cols/32)
     uint32, 32 cells per lane.  The ghost ring is exchanged on packed words
     — one word column per side carries the cross-shard neighbor bits, the
     same ``ppermute`` pattern as the dense path but 32x fewer bytes per
-    cell.  Radius-1 rules only (the packed adder tree is radius-1)."""
-    from mpi_tpu.ops.bitlife import bit_next, column_sums
+    cell.  Radius-1 rules only (the packed adder tree is radius-1).
 
+    ``gens_per_exchange`` = K > 1: one exchange of K ghost rows (and still
+    a single ghost word column — 32 halo bits cover any K ≤ 8) feeds K
+    local generations.  The ghost word columns are recomputed each
+    generation with zeros past the padding, which corrupts them one bit
+    per generation inward from the far edge — harmless while K ≤ 31 — and
+    the vertical fringe shrinks one row per generation, reaching exactly
+    the local tile after K.  Collective count drops K×.
+    """
+    from mpi_tpu.ops.bitlife import bit_next, column_sums
+    from mpi_tpu.parallel.halo import exchange_halo_rc
+
+    K = gens_per_exchange
     if rule.radius != 1:
         raise ValueError("bitpacked sharded stepper supports radius-1 rules only")
+    if not 1 <= K <= 8:
+        raise ValueError(f"gens_per_exchange must be in 1..8, got {K}")
+    if K > 1 and 0 in rule.birth:
+        raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
     spec = PartitionSpec(*axes)
+    periodic = boundary == "periodic"
 
-    @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
-    def local_step(local):
-        h, nw = local.shape
-        p = exchange_halo(local, 1, boundary, axes)  # (h+2, nw+2) words
-        # vertical column sums over the full padded width, once; the
-        # left/right neighbor-word sums are then just column slices
-        f0, f1, c0, c1 = column_sums(p[0:h], p[1 : h + 1], p[2 : h + 2])
-        return bit_next(
-            f0[:, 1:-1], f1[:, 1:-1], c0[:, 1:-1], c1[:, 1:-1],
-            f0[:, 0:nw], f1[:, 0:nw], f0[:, 2:], f1[:, 2:],
-            p[1 : h + 1, 1:-1], rule,
-        )
+    def one_gen(p, rule):
+        """Next state of rows [1, n-1) of p, over the full word width with
+        zeros past the array (callers mask/trim the edges)."""
+        n, w = p.shape
+        zcol = jnp.zeros((n - 2, 1), dtype=p.dtype)
+        f0, f1, c0, c1 = column_sums(p[0 : n - 2], p[1 : n - 1], p[2:n])
+        f0p = jnp.concatenate([zcol, f0[:, :-1]], axis=1)
+        f1p = jnp.concatenate([zcol, f1[:, :-1]], axis=1)
+        f0n = jnp.concatenate([f0[:, 1:], zcol], axis=1)
+        f1n = jnp.concatenate([f1[:, 1:], zcol], axis=1)
+        return bit_next(f0, f1, c0, c1, f0p, f1p, f0n, f1n, p[1 : n - 1], rule)
 
-    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
-    def evolve(packed, steps: int):
-        def body(g, _):
-            return local_step(g), None
+    def make_local(k):
+        @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+        def local_step(local):
+            # k-deep ghost rows, one ghost word column: (h+2k, nw+2)
+            p = exchange_halo_rc(local, k, 1, boundary, axes)
+            for g in range(k):
+                p = one_gen(p, rule)
+                fringe = k - 1 - g
+                if not periodic and fringe:
+                    # fringe rows / the ghost word columns lie outside the
+                    # global grid on the edge shards — re-kill them (margins
+                    # in packed units: rows are rows, columns are words)
+                    p = _kill_outside_global(p, axes, (fringe, fringe, 1, 1))
+            return p[:, 1:-1]
 
-        out, _ = lax.scan(body, packed, None, length=steps)
-        return out
+        return local_step
 
-    return evolve
+    return _segmented_evolve(make_local, K)
 
 
 def sharded_bit_init(mesh: Mesh, rows: int, cols: int, seed: int, axes=AXES):

@@ -42,6 +42,40 @@ def test_cli_backends_bit_identical(tmp_path):
         np.testing.assert_array_equal(g, grids[0])
 
 
+def test_cli_comm_every_matches_oracle(tmp_path):
+    # communication-avoiding halo depth must not change results (snapshot
+    # gap 8 with K=3 also exercises the remainder path: 3+3+2 per segment)
+    run_cli(tmp_path, "ce", "tpu", extra=("--comm-every", "3"))
+    final = golio.assemble(str(tmp_path), "ce", 16)
+    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_cli_comm_every_rejects_out_of_range(tmp_path):
+    rc = main([
+        "32", "32", "8", "16", "--backend", "tpu", "--out-dir", str(tmp_path),
+        "--comm-every", "9", "--quiet",
+    ])
+    assert rc == 2
+
+
+def test_cli_comm_every_rejects_non_tpu_backend(tmp_path):
+    rc = main([
+        "32", "32", "8", "16", "--backend", "serial", "--out-dir", str(tmp_path),
+        "--comm-every", "4", "--quiet",
+    ])
+    assert rc == 2
+
+
+def test_config_rejects_ghost_deeper_than_tile():
+    from mpi_tpu.config import ConfigError, GolConfig
+    import pytest as _pytest
+
+    # 4-row tiles cannot source an 8-deep ghost ring even on a 1-shard axis
+    with _pytest.raises(ConfigError):
+        GolConfig(rows=4, cols=32, steps=1, mesh_shape=(1, 1), comm_every=8)
+
+
 def test_cli_snapshot_series(tmp_path):
     run_cli(tmp_path, "series", "serial")
     assert golio.list_snapshot_iterations(str(tmp_path), "series") == [0, 8, 16]

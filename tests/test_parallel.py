@@ -111,6 +111,64 @@ def test_sharded_bit_stepper(mesh_shape, boundary):
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1)])
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+@pytest.mark.parametrize("K", [2, 4])
+def test_sharded_deep_halo_gens(mesh_shape, boundary, K):
+    # communication-avoiding: one K-deep exchange per K local generations
+    mesh = make_mesh(mesh_shape)
+    R = C = 64
+    g0 = init_tile_np(R, C, seed=37)
+    evolve = make_sharded_stepper(mesh, LIFE, boundary, gens_per_exchange=K)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(evolve(g, 6 * K)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 6 * K, LIFE, boundary))
+
+
+def test_sharded_deep_halo_gens_radius2():
+    # LtL radius-2 rule with K=2: 4-deep exchanged fringe, shrinks 2/gen
+    from mpi_tpu.models.rules import Rule
+
+    r2 = Rule("r2test", frozenset({7, 8}), frozenset(range(5, 10)), radius=2)
+    mesh = make_mesh((2, 4))
+    g0 = init_tile_np(64, 64, seed=43)
+    evolve = make_sharded_stepper(mesh, r2, "dead", gens_per_exchange=2)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(evolve(g, 4)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 4, r2, "dead"))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (1, 8)])
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+@pytest.mark.parametrize("K", [3, 8])
+def test_sharded_bit_stepper_gens(mesh_shape, boundary, K):
+    from mpi_tpu.parallel.step import (
+        make_sharded_bit_stepper, sharded_bit_init, sharded_unpack,
+    )
+
+    mesh = make_mesh(mesh_shape)
+    R, C = 64, 256
+    p = sharded_bit_init(mesh, R, C, seed=41)
+    ev = make_sharded_bit_stepper(mesh, LIFE, boundary, gens_per_exchange=K)
+    out = np.asarray(jax.device_get(sharded_unpack(mesh, ev(p, 3 * K))))
+    ref = evolve_np(init_tile_np(R, C, seed=41), 3 * K, LIFE, boundary)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sharded_gens_remainder_steps():
+    # steps not a multiple of K: one 4-gen pass plus a 2-gen remainder
+    from mpi_tpu.parallel.step import (
+        make_sharded_bit_stepper, sharded_bit_init, sharded_unpack,
+    )
+
+    mesh = make_mesh((2, 4))
+    p = sharded_bit_init(mesh, 64, 256, seed=1)
+    ev = make_sharded_bit_stepper(mesh, LIFE, "periodic", gens_per_exchange=4)
+    out = np.asarray(jax.device_get(sharded_unpack(mesh, ev(p, 6))))
+    ref = evolve_np(init_tile_np(64, 256, seed=1), 6, LIFE, "periodic")
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_run_tpu_packed_dispatch(tmp_path):
     # cols/mesh_j % 32 == 0 → packed engine; result must match oracle
     from mpi_tpu.backends.tpu import run_tpu
@@ -120,4 +178,18 @@ def test_run_tpu_packed_dispatch(tmp_path):
     out = run_tpu(cfg)
     np.testing.assert_array_equal(
         out, evolve_np(init_tile_np(64, 256, seed=3), 12, LIFE, "periodic")
+    )
+
+
+def test_run_tpu_packed_comm_every(tmp_path):
+    # packed engine end-to-end with deep halos (comm_every wiring in
+    # run_tpu's packed branch), steps not a multiple of K
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    cfg = GolConfig(rows=64, cols=256, steps=14, seed=3, comm_every=3,
+                    boundary="dead")
+    out = run_tpu(cfg)
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(64, 256, seed=3), 14, LIFE, "dead")
     )

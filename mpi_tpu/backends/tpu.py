@@ -52,6 +52,32 @@ def _shard_tiles(grid: jax.Array) -> List[Tuple[int, np.ndarray, int, int]]:
     return out
 
 
+def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
+    """Packed-engine stepper: on a single device the fused Pallas SWAR
+    kernel (ops/pallas_bitlife.py) replaces the shard_map/XLA path — no
+    halo exchange exists, and ``comm_every`` becomes the kernel's
+    temporal-blocking depth (generations per HBM round-trip).  Off-TPU
+    the kernel runs in interpret mode (tests); multi-device meshes use
+    the ppermute stepper."""
+    from mpi_tpu.parallel.step import make_sharded_bit_stepper
+
+    if n_devices == 1:
+        from mpi_tpu.ops.pallas_bitlife import make_pallas_bit_stepper, supports
+
+        gens = config.comm_every
+        shape = (config.rows, config.cols)
+        if supports(shape, config.rule, gens=gens) and not (
+            gens > 1 and 0 in config.rule.birth
+        ):
+            interpret = jax.devices()[0].platform != "tpu"
+            return make_pallas_bit_stepper(
+                config.rule, config.boundary, interpret=interpret, gens=gens
+            )
+    return make_sharded_bit_stepper(
+        mesh, config.rule, config.boundary, gens_per_exchange=config.comm_every
+    )
+
+
 def run_tpu(
     config: GolConfig,
     timer: Optional[PhaseTimer] = None,
@@ -87,13 +113,10 @@ def run_tpu(
     packed_mode = config.rule.radius == 1 and (config.cols // mj) % WORD == 0
     if packed_mode:
         from mpi_tpu.parallel.step import (
-            make_sharded_bit_stepper, sharded_bit_init, make_sharded_unpacker,
+            sharded_bit_init, make_sharded_unpacker,
         )
 
-        evolve = make_sharded_bit_stepper(
-            mesh, config.rule, config.boundary,
-            gens_per_exchange=config.comm_every,
-        )
+        evolve = _pick_packed_evolve(config, mesh, mi * mj)
         if initial is not None:
             grid = jax.device_put(pack_np(initial), grid_sharding(mesh))
         else:

@@ -61,11 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workers", type=int, default=0,
                    help="cpp-par worker threads (default: auto)")
     p.add_argument("--comm-every", type=int, default=1, metavar="K",
-                   help="tpu backend: generations per halo exchange (1..8). "
+                   help="tpu backend: generations per halo exchange (1..16). "
                    "K > 1 exchanges a K-deep ghost ring and runs K local "
                    "generations between collectives (communication-avoiding; "
                    "the deep-halo optimization the reference's per-step "
-                   "barrier+exchange loop leaves out, main.cpp:291-305)")
+                   "barrier+exchange loop leaves out, main.cpp:291-305); on "
+                   "a single device K is the Pallas kernel's temporal-"
+                   "blocking depth (generations per HBM round-trip)")
     p.add_argument("--name", default=None, help="run name (default: timestamp)")
     p.add_argument("--strict", action="store_true",
                    help="enforce the reference's validation rules "

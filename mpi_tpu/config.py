@@ -73,7 +73,7 @@ class GolConfig:
     mesh_shape: Optional[Tuple[int, int]] = None  # device mesh (rows_axis, cols_axis); None = auto
     out_dir: str = "."
     workers: int = 0                 # native backend threads; 0 = auto
-    comm_every: int = 1              # TPU: generations per halo exchange (1..8)
+    comm_every: int = 1              # TPU: generations per halo exchange (1..16)
 
     def __post_init__(self):
         if self.rows <= 0 or self.cols <= 0:
@@ -88,8 +88,8 @@ class GolConfig:
             raise ConfigError(
                 f"backend must be one of tpu/serial/cpp/cpp-par, got {self.backend!r}"
             )
-        if not 1 <= self.comm_every <= 8:
-            raise ConfigError(f"comm_every must be in 1..8, got {self.comm_every}")
+        if not 1 <= self.comm_every <= 16:
+            raise ConfigError(f"comm_every must be in 1..16, got {self.comm_every}")
         if self.comm_every > 1 and self.backend != "tpu":
             raise ConfigError(
                 f"comm_every applies to the tpu backend only "

@@ -15,11 +15,12 @@ from ``pltpu.roll`` lane rotation (the cross-word carry bits ride along
 inside the rotated words).  Dead boundary: edge slabs zeroed, rotated
 edge words masked with a lane iota.
 
-Temporal blocking (``gens`` > 1): the 8-row DMA-alignment halo is deeper
-than the rule's radius-1 needs, so after one HBM round-trip the slab can
-be stepped up to 8 generations in VMEM — each generation shrinks the
-valid row window by one from each side, and after ``gens`` generations
-the middle BM rows are exactly ``gens`` steps ahead.  Neighboring blocks
+Temporal blocking (``gens`` > 1): the DMA-alignment halo (8 rows, or 16
+for gens > 8) is deeper than the rule's radius-1 needs, so after one HBM
+round-trip the slab can be stepped up to 16 generations in VMEM — each
+generation shrinks the valid row window by one from each side, and after
+``gens`` generations the middle BM rows are exactly ``gens`` steps
+ahead.  Neighboring blocks
 recompute each other's halo rows redundantly from the same input (the
 classic overlapped/trapezoidal stencil tiling), so blocks stay
 independent.  HBM traffic drops by ``gens``× for ~(2·gens/BM) extra
@@ -28,8 +29,6 @@ is the difference between ~30% and ~100% VPU occupancy.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -64,16 +63,22 @@ def _pick_blocks(H: int, NW: int, gens: int = 1) -> tuple[int, int] | None:
     beats every (·, ≤64) shape) and bound the unrolled sub-tile count —
     then the largest slab BM that still fits."""
     sizes = (512, 256, 128, 64, 32, 16, 8)
+    halo = _halo_rows(gens)
+
+    def bm_ok(bm):
+        # wrapped halo-slab DMA starts must stay halo-aligned
+        return H % bm == 0 and (halo <= 8 or (H % halo == 0 and bm % halo == 0))
+
     if NW > 512:
         limit = int(15.75 * (1 << 20))
         for bm in sizes:
-            if H % bm:
+            if not bm_ok(bm):
                 continue
-            dbuf = 2 * (bm + 16) * NW * 4
+            dbuf = 2 * (bm + 2 * halo) * NW * 4
             temps = 13.5 * (bm + 2 * gens + 2) * NW * 4
             if dbuf + temps <= limit:
-                # CM = BM + 16 ≥ BM + 2·(gens−1): every window single-tile
-                return bm, bm + 16
+                # CM ≥ BM + 2·(gens−1): every window single-tile
+                return bm, bm + 2 * halo
         return None
     limit = int(15.25 * (1 << 20))
     for cm in sizes:
@@ -81,9 +86,9 @@ def _pick_blocks(H: int, NW: int, gens: int = 1) -> tuple[int, int] | None:
         if room <= 0:
             continue
         for bm in sizes:
-            if bm < cm or H % bm:
+            if bm < cm or not bm_ok(bm):
                 continue
-            if 2 * (bm + 16) * NW * 4 <= room:
+            if 2 * (bm + 2 * halo) * NW * 4 <= room:
                 return bm, cm
     return None
 
@@ -106,14 +111,25 @@ def supports(shape, rule: Rule, gens: int = 1) -> bool:
     )
 
 
+def _halo_rows(gens: int) -> int:
+    # DMA row slices must be 8-sublane aligned; the halo must also cover
+    # one consumed row per temporally-blocked generation
+    return 8 if gens <= 8 else 16
+
+
 def _make_kernel(
     rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int, gens: int = 1
 ):
     periodic = boundary == "periodic"
     nblocks = H // BM
-    HALO = 8  # DMA row slices must be 8-sublane aligned; radius is 1
-    if not 1 <= gens <= HALO:
-        raise ValueError(f"gens must be in 1..{HALO}, got {gens}")
+    HALO = _halo_rows(gens)
+    if not 1 <= gens <= 16:
+        raise ValueError(f"gens must be in 1..16, got {gens}")
+    if HALO > 8 and (H % HALO or BM % HALO):
+        raise ValueError(
+            f"gens={gens} needs H and BM to be multiples of {HALO} "
+            f"(wrapped halo-slab DMAs), got H={H}, BM={BM}"
+        )
 
     def _block_dmas(in_hbm, dbuf, sems, blk, slot):
         base = blk * BM
@@ -158,9 +174,10 @@ def _make_kernel(
         scratch = dbuf.at[slot]
 
         if not periodic:
-            # Zero the whole 8-row edge slabs: rows beyond the grid are dead,
-            # and (absent birth-on-0) they stay dead through every in-VMEM
-            # generation, so the multi-gen loop needs no re-masking.
+            # Zero the whole edge slabs: rows beyond the grid are dead.
+            # (This only establishes the gen-0 state — during multi-gen
+            # loops the rows adjacent to live grid rows can be "born" and
+            # must be re-killed after every generation; see below.)
             @pl.when(i == 0)
             def _():
                 scratch[0:HALO, :] = jnp.zeros((HALO, NW), dtype=jnp.uint32)
@@ -265,7 +282,7 @@ def pallas_bit_step(
 ) -> jax.Array:
     """``gens`` generations (default one) on a packed (H, W/32) uint32 grid
     via the fused SWAR kernel, in a single HBM round-trip.  Requires
-    ``supports((H, W), rule)`` and ``gens <= 8``.  ``blocks`` overrides the
+    ``supports((H, W), rule)`` and ``gens <= 16``.  ``blocks`` overrides the
     auto-picked (BM, CM) DMA-slab/compute-tile rows (tests)."""
     H, NW = packed.shape
     picked = blocks or _pick_blocks(H, NW, gens)
@@ -283,29 +300,11 @@ def pallas_bit_step(
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((BM, NW), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, BM + 16, NW), jnp.uint32),
+            pltpu.VMEM((2, BM + 2 * _halo_rows(gens), NW), jnp.uint32),
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=interpret,
     )(packed)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("rule", "boundary", "steps", "interpret", "gens"),
-    donate_argnums=0,
-)
-def _evolve_bits_pallas(packed, rule, boundary, steps, interpret, gens=1):
-    gens = max(1, min(gens, steps))
-
-    def body(p, _):
-        return pallas_bit_step(p, rule, boundary, interpret=interpret, gens=gens), None
-
-    full, rem = divmod(steps, gens)
-    out, _ = lax.scan(body, packed, None, length=full)
-    if rem:
-        out = pallas_bit_step(out, rule, boundary, interpret=interpret, gens=rem)
-    return out
 
 
 def make_pallas_bit_stepper(
@@ -315,9 +314,14 @@ def make_pallas_bit_stepper(
     gens: int = 1,
 ):
     """evolve(packed, steps) on packed uint32 grids, running ``gens``
-    generations per kernel pass (temporal blocking)."""
+    generations per kernel pass (temporal blocking); jitted with donated
+    input, so ``evolve.lower`` works for ahead-of-time compilation."""
+    from mpi_tpu.utils.segmenting import segmented_evolve
 
-    def evolve(packed: jax.Array, steps: int) -> jax.Array:
-        return _evolve_bits_pallas(packed, rule, boundary, steps, interpret, gens)
+    def make_local(k):
+        def local(p):
+            return pallas_bit_step(p, rule, boundary, interpret=interpret, gens=k)
 
-    return evolve
+        return local
+
+    return segmented_evolve(make_local, gens)

@@ -70,7 +70,7 @@ def exchange_halo_rc(local, radius_rows: int, radius_cols: int, boundary: str,
                      axes=AXES):
     """``exchange_halo`` with independent row/column ghost depths — the
     bitpacked stepper exchanges K ghost rows but a single ghost *word*
-    column (32 halo bits cover any K ≤ 8)."""
+    column (32 halo bits cover any K ≤ 16)."""
     periodic = boundary == "periodic"
     x = _axis_exchange(local, axes[0], 0, radius_rows, periodic)
     return _axis_exchange(x, axes[1], 1, radius_cols, periodic)

@@ -27,6 +27,7 @@ from mpi_tpu.ops.stencil import counts_from_padded, apply_rule
 from mpi_tpu.parallel.halo import exchange_halo
 from mpi_tpu.parallel.mesh import AXES
 from mpi_tpu.utils.hashinit import init_tile_jnp
+from mpi_tpu.utils.segmenting import segmented_evolve
 
 
 def grid_sharding(mesh: Mesh, axes=AXES) -> NamedSharding:
@@ -101,29 +102,7 @@ def make_sharded_stepper(
 
         return local_step
 
-    return _segmented_evolve(make_local, K)
-
-
-def _segmented_evolve(make_local, K):
-    """evolve(grid, steps): scan ``steps // K`` K-generation exchanges plus
-    a single (steps % K)-generation remainder exchange."""
-
-    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
-    def evolve(grid, steps: int):
-        k = max(1, min(K, steps))  # short segments: skip tracing unused depth
-        full, rem = divmod(steps, k)
-        if full:
-            step_k = make_local(k)
-
-            def body(g, _):
-                return step_k(g), None
-
-            grid, _ = lax.scan(body, grid, None, length=full)
-        if rem:
-            grid = make_local(rem)(grid)
-        return grid
-
-    return evolve
+    return segmented_evolve(make_local, K)
 
 
 def make_sharded_bit_stepper(
@@ -136,7 +115,7 @@ def make_sharded_bit_stepper(
     cell.  Radius-1 rules only (the packed adder tree is radius-1).
 
     ``gens_per_exchange`` = K > 1: one exchange of K ghost rows (and still
-    a single ghost word column — 32 halo bits cover any K ≤ 8) feeds K
+    a single ghost word column — 32 halo bits cover any K ≤ 16) feeds K
     local generations.  The ghost word columns are recomputed each
     generation with zeros past the padding, which corrupts them one bit
     per generation inward from the far edge — harmless while K ≤ 31 — and
@@ -149,8 +128,8 @@ def make_sharded_bit_stepper(
     K = gens_per_exchange
     if rule.radius != 1:
         raise ValueError("bitpacked sharded stepper supports radius-1 rules only")
-    if not 1 <= K <= 8:
-        raise ValueError(f"gens_per_exchange must be in 1..8, got {K}")
+    if not 1 <= K <= 16:
+        raise ValueError(f"gens_per_exchange must be in 1..16, got {K}")
     if K > 1 and 0 in rule.birth:
         raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
     spec = PartitionSpec(*axes)
@@ -185,7 +164,7 @@ def make_sharded_bit_stepper(
 
         return local_step
 
-    return _segmented_evolve(make_local, K)
+    return segmented_evolve(make_local, K)
 
 
 def sharded_bit_init(mesh: Mesh, rows: int, cols: int, seed: int, axes=AXES):

@@ -1,0 +1,44 @@
+"""Shared K-generation segmentation for evolution drivers.
+
+Both the sharded steppers (``parallel/step.py``, K = generations per halo
+exchange) and the single-device Pallas stepper (``ops/pallas_bitlife.py``,
+K = temporally-blocked generations per HBM pass) advance a grid in
+K-generation segments with a remainder segment — one implementation here
+so the clamp/divmod/remainder logic cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+def segmented_evolve(make_local, K: int):
+    """evolve(grid, steps): scan ``steps // K`` K-generation segments plus
+    a single (steps % K)-generation remainder segment.
+
+    ``make_local(k)`` must return a function advancing a grid by ``k``
+    generations; it is only invoked for segment lengths that actually run
+    (short runs never trace unused depth).  The returned ``evolve`` is
+    jitted with donated input, so ``evolve.lower(grid, steps)`` works for
+    ahead-of-time segment compilation.
+    """
+
+    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
+    def evolve(grid, steps: int):
+        k = max(1, min(K, steps))
+        full, rem = divmod(steps, k)
+        if full:
+            step_k = make_local(k)
+
+            def body(g, _):
+                return step_k(g), None
+
+            grid, _ = lax.scan(body, grid, None, length=full)
+        if rem:
+            grid = make_local(rem)(grid)
+        return grid
+
+    return evolve

@@ -54,7 +54,7 @@ def test_cli_comm_every_matches_oracle(tmp_path):
 def test_cli_comm_every_rejects_out_of_range(tmp_path):
     rc = main([
         "32", "32", "8", "16", "--backend", "tpu", "--out-dir", str(tmp_path),
-        "--comm-every", "9", "--quiet",
+        "--comm-every", "17", "--quiet",
     ])
     assert rc == 2
 

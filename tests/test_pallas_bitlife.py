@@ -42,6 +42,27 @@ def test_multi_gen_parity(gens, boundary):
     )
 
 
+@pytest.mark.parametrize("gens", [9, 12, 16])
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_deep_gen_parity(gens, boundary):
+    # gens > 8 switches to 16-row DMA halos
+    g = init_tile_np(32, 4096, seed=21)
+    np.testing.assert_array_equal(
+        _run(g, LIFE, boundary, gens), evolve_np(g, gens, LIFE, boundary)
+    )
+
+
+def test_deep_gen_multiblock():
+    # 16-row halo with several 16-row blocks, wrapped slab DMAs
+    g = init_tile_np(64, 4096, seed=22)
+    p = jnp.asarray(pack_np(g))
+    out = pallas_bit_step(p, LIFE, "periodic", interpret=True, gens=12,
+                          blocks=(16, 48))
+    np.testing.assert_array_equal(
+        unpack_np(np.asarray(out)), evolve_np(g, 12, LIFE, "periodic")
+    )
+
+
 @pytest.mark.parametrize("boundary", ["periodic", "dead"])
 def test_multi_gen_multiblock(boundary):
     # H=48 → BM=16, 3 blocks: generations recompute across block halos
@@ -94,7 +115,7 @@ def test_multi_gen_rejects_birth_on_zero():
 def test_gens_bounds():
     p = jnp.zeros((16, 128), dtype=jnp.uint32)
     with pytest.raises(ValueError):
-        pallas_bit_step(p, LIFE, "periodic", interpret=True, gens=9)
+        pallas_bit_step(p, LIFE, "periodic", interpret=True, gens=17)
 
 
 def test_supports_and_blocks():

@@ -181,6 +181,20 @@ def test_run_tpu_packed_dispatch(tmp_path):
     )
 
 
+def test_run_tpu_single_device_pallas_path(tmp_path):
+    # 1x1 mesh + lane-aligned width → the fused Pallas SWAR kernel (in
+    # interpret mode off-TPU), with comm_every as temporal-blocking depth
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    cfg = GolConfig(rows=16, cols=4096, steps=7, seed=11, comm_every=3,
+                    mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(16, 4096, seed=11), 7, LIFE, "periodic")
+    )
+
+
 def test_run_tpu_packed_comm_every(tmp_path):
     # packed engine end-to-end with deep halos (comm_every wiring in
     # run_tpu's packed branch), steps not a multiple of K

@@ -2,18 +2,25 @@
 
 Times the scanned pallas_bit_step over grid sizes (scalar popcount output
 forced to host, same methodology as bench.py — block_until_ready alone
-under-reports on the tunneled platform), reports cells/s and effective HBM
-bandwidth, plus an empirically measured uint32 VPU op roof.
+under-reports on the tunneled platform), reports cells/s, effective HBM
+bandwidth, and compile time, plus an empirically measured uint32 VPU op
+roof.  Usage: ``python tools/profile_kernel.py [gens]`` (default 8
+temporally-blocked generations per HBM pass).
 """
 
 import functools
+import os
+import sys
 import time
 
 import numpy as np
 
+if __package__ in (None, ""):  # direct `python tools/profile_kernel.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from mpi_tpu.models.rules import LIFE
 from mpi_tpu.ops.bitlife import WORD, init_packed
-from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, _pick_blocks
 
 
 def vpu_roof(jax, jnp, lax):
@@ -48,32 +55,37 @@ def main():
     roof = vpu_roof(jax, jnp, lax)
     print(f"VPU u32 roof (xor/shift/add chain): {roof/1e12:.2f} Tops/s")
 
-    @functools.partial(jax.jit, static_argnames=("steps",))
-    def evolve_pop(p, steps):
+    gens = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
+    @functools.partial(jax.jit, static_argnames=("steps", "g"))
+    def evolve_pop(p, steps, g):
         out, _ = lax.scan(
-            lambda x, _: (pallas_bit_step(x, LIFE, "periodic"), None),
-            p, None, length=steps,
+            lambda x, _: (pallas_bit_step(x, LIFE, "periodic", gens=g), None),
+            p, None, length=steps // g,
         )
         return jnp.sum(lax.population_count(out).astype(jnp.uint32))
 
     for side in (4096, 8192, 16384, 32768, 65536):
         # enough steps that the ~70 ms tunnel round-trip is <2% of the call
         steps = max(64, min(2048, int(2**31 / (side * side) * 64)))
+        steps -= steps % gens
         packed = init_packed(side, side, seed=1)
-        int(np.asarray(evolve_pop(packed, steps)))  # compile + warm
+        t0 = time.perf_counter()
+        int(np.asarray(evolve_pop(packed, steps, gens)))  # compile + warm
+        compile_s = time.perf_counter() - t0
         best = None
         for _ in range(3):
             t0 = time.perf_counter()
-            int(np.asarray(evolve_pop(packed, steps)))
+            int(np.asarray(evolve_pop(packed, steps, gens)))
             dt = (time.perf_counter() - t0) / steps
             best = dt if best is None else min(best, dt)
         cells = side * side
-        bw = 2 * cells / 8
+        bw = 2 * cells / 8 / gens  # HBM bytes amortized over gens per pass
         print(
-            f"{side:6d}^2: {best*1e3:7.3f} ms/step  "
+            f"{side:6d}^2 gens={gens} blocks={_pick_blocks(side, side // WORD, gens)}: "
+            f"{best*1e3:7.3f} ms/step  "
             f"{cells/best/1e9:7.1f} Gcell/s  "
-            f"HBM {bw/best/1e9:6.1f} GB/s  "
-            f"(~90 ops/word -> {cells/WORD*90/best/1e12:.2f} Tops/s)"
+            f"HBM {bw/best/1e9:6.1f} GB/s  compile {compile_s:.0f}s"
         )
         del packed
 

@@ -66,6 +66,8 @@ def main(argv=None) -> int:
     p.add_argument("--rule", default="life")
     p.add_argument("--boundary", default="periodic")
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--comm-every", type=int, default=1,
+                   help="generations per halo exchange (1..16)")
     p.add_argument("--out-dir", default=".")
     p.add_argument("--time-file", default="sweep")
     args = p.parse_args(argv)
@@ -97,10 +99,14 @@ def main(argv=None) -> int:
         timer = PhaseTimer()
         if packed:
             grid = sharded_bit_init(mesh, rows, cols, args.seed)
-            evolve = make_sharded_bit_stepper(mesh, rule, args.boundary)
+            evolve = make_sharded_bit_stepper(
+                mesh, rule, args.boundary, gens_per_exchange=args.comm_every
+            )
         else:
             grid = sharded_init(mesh, rows, cols, args.seed)
-            evolve = make_sharded_stepper(mesh, rule, args.boundary)
+            evolve = make_sharded_stepper(
+                mesh, rule, args.boundary, gens_per_exchange=args.comm_every
+            )
         compiled = evolve.lower(grid, args.steps).compile()
         jax.block_until_ready(grid)
         timer.setup_done()
@@ -117,6 +123,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "devices": n, "mesh": list(shape), "grid": [rows, cols],
             "steps": args.steps, "engine": "bitpacked" if packed else "dense",
+            "comm_every": args.comm_every,
             "cells_per_sec": round(cps, 1),
             "weak_scaling_efficiency": round(eff, 4),
         }))

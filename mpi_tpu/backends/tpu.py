@@ -66,15 +66,15 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
 
         gens = config.comm_every
         shape = (config.rows, config.cols)
-        if supports(shape, config.rule, gens=gens) and not (
-            gens > 1 and 0 in config.rule.birth
-        ):
+        # (birth-on-0 with gens > 1 is already rejected by GolConfig)
+        if supports(shape, config.rule, gens=gens):
             interpret = jax.devices()[0].platform != "tpu"
             return make_pallas_bit_stepper(
                 config.rule, config.boundary, interpret=interpret, gens=gens
             )
     return make_sharded_bit_stepper(
-        mesh, config.rule, config.boundary, gens_per_exchange=config.comm_every
+        mesh, config.rule, config.boundary,
+        gens_per_exchange=config.comm_every, overlap=config.overlap,
     )
 
 
@@ -111,6 +111,23 @@ def run_tpu(
     from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
 
     packed_mode = config.rule.radius == 1 and (config.cols // mj) % WORD == 0
+    if config.overlap and mi * mj > 1:
+        # fail fast instead of silently running without the requested
+        # overlap: the stitched-band stepper needs the packed engine and
+        # tiles tall enough for its K-row edge bands
+        from mpi_tpu.config import ConfigError
+
+        if not packed_mode:
+            raise ConfigError(
+                f"--overlap needs the packed engine: per-shard width "
+                f"{config.cols // mj} is not a multiple of {WORD}"
+            )
+        if config.rows // mi < 2 * config.comm_every or (config.cols // mj) // WORD < 2:
+            raise ConfigError(
+                f"--overlap needs tiles >= {2 * config.comm_every} rows x "
+                f"{2 * WORD} cols (got "
+                f"{config.rows // mi}x{config.cols // mj})"
+            )
     if packed_mode:
         from mpi_tpu.parallel.step import (
             sharded_bit_init, make_sharded_unpacker,

@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                    "barrier+exchange loop leaves out, main.cpp:291-305); on "
                    "a single device K is the Pallas kernel's temporal-"
                    "blocking depth (generations per HBM round-trip)")
+    p.add_argument("--overlap", action="store_true",
+                   help="tpu backend, packed engine, periodic boundary: "
+                   "overlap the ppermute halo exchange with interior "
+                   "compute (edge bands recomputed from the halo and "
+                   "stitched in; the comm/compute overlap the reference's "
+                   "barrier-then-exchange loop forgoes, main.cpp:297-299)")
     p.add_argument("--name", default=None, help="run name (default: timestamp)")
     p.add_argument("--strict", action="store_true",
                    help="enforce the reference's validation rules "
@@ -151,6 +157,7 @@ def _run(args) -> int:
         out_dir=args.out_dir,
         workers=args.workers,
         comm_every=args.comm_every,
+        overlap=args.overlap,
     )
     if args.strict:
         config.validate_strict()

@@ -74,6 +74,7 @@ class GolConfig:
     out_dir: str = "."
     workers: int = 0                 # native backend threads; 0 = auto
     comm_every: int = 1              # TPU: generations per halo exchange (1..16)
+    overlap: bool = False            # TPU packed engine: overlap ppermute with interior compute
 
     def __post_init__(self):
         if self.rows <= 0 or self.cols <= 0:
@@ -97,6 +98,11 @@ class GolConfig:
             )
         if self.comm_every > 1 and 0 in self.rule.birth:
             raise ConfigError("comm_every > 1 requires a rule without birth-on-0")
+        if self.overlap:
+            if self.backend != "tpu":
+                raise ConfigError("overlap applies to the tpu backend only")
+            if self.boundary != "periodic":
+                raise ConfigError("overlap requires the periodic boundary")
         if self.mesh_shape is not None and self.backend == "tpu":
             # only the tpu backend shards over the mesh / slices ghost
             # rings; other backends ignore mesh_shape entirely

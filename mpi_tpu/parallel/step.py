@@ -106,7 +106,8 @@ def make_sharded_stepper(
 
 
 def make_sharded_bit_stepper(
-    mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1
+    mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1,
+    overlap: bool = False,
 ):
     """Bitpacked (SWAR) shard-parallel evolution: grids are (rows, cols/32)
     uint32, 32 cells per lane.  The ghost ring is exchanged on packed words
@@ -121,6 +122,17 @@ def make_sharded_bit_stepper(
     per generation inward from the far edge — harmless while K ≤ 31 — and
     the vertical fringe shrinks one row per generation, reaching exactly
     the local tile after K.  Collective count drops K×.
+
+    ``overlap=True`` (periodic only) removes the data dependency between
+    the ppermute and the bulk of the stencil — the optimization the
+    reference's barrier-then-exchange loop forgoes entirely
+    (``/root/reference/main.cpp:297-299``): the tile interior evolves K
+    generations from local data alone (valid rows shrink to [K, h-K)
+    under the trapezoid rule) while the collective is in flight, and only
+    the K edge rows per side plus the outermost word columns are
+    recomputed from the exchanged halo and stitched in.  XLA's async
+    collectives + latency-hiding scheduler overlap the two automatically
+    once the dependency is gone.
     """
     from mpi_tpu.ops.bitlife import bit_next, column_sums
     from mpi_tpu.parallel.halo import exchange_halo_rc
@@ -132,6 +144,8 @@ def make_sharded_bit_stepper(
         raise ValueError(f"gens_per_exchange must be in 1..16, got {K}")
     if K > 1 and 0 in rule.birth:
         raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
+    if overlap and boundary != "periodic":
+        raise ValueError("overlap=True supports the periodic boundary only")
     spec = PartitionSpec(*axes)
     periodic = boundary == "periodic"
 
@@ -147,9 +161,15 @@ def make_sharded_bit_stepper(
         f1n = jnp.concatenate([f1[:, 1:], zcol], axis=1)
         return bit_next(f0, f1, c0, c1, f0p, f1p, f0n, f1n, p[1 : n - 1], rule)
 
+    def evolve_band(band, k):
+        """k generations over a row band (zeros assumed past every edge);
+        each generation trims one row per side — trapezoid validity."""
+        for _ in range(k):
+            band = one_gen(band, rule)
+        return band
+
     def make_local(k):
-        @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
-        def local_step(local):
+        def body_exchange_all(local):
             # k-deep ghost rows, one ghost word column: (h+2k, nw+2)
             p = exchange_halo_rc(local, k, 1, boundary, axes)
             for g in range(k):
@@ -161,6 +181,31 @@ def make_sharded_bit_stepper(
                     # in packed units: rows are rows, columns are words)
                     p = _kill_outside_global(p, axes, (fringe, fringe, 1, 1))
             return p[:, 1:-1]
+
+        def body_overlap(local):
+            h, nw = local.shape
+            p = exchange_halo_rc(local, k, 1, boundary, axes)  # (h+2k, nw+2)
+            # Interior: k generations from `local` alone — independent of
+            # the ppermute above, so the scheduler can overlap them.
+            # Trapezoid validity: rows [k, h-k) of the tile; edge-word bit
+            # corruption (< k bits from the zero-assumed sides) lies in
+            # the word columns replaced below.
+            q = evolve_band(local, k)  # (h-2k, nw)
+            # Edge bands from the exchanged halo (full padded width, so
+            # their corners are exact): output row i = input row i+k.
+            tb = evolve_band(p[: 4 * k], k)[:k, 1:-1]        # tile rows [0, k)
+            bb = evolve_band(p[h - 2 * k :], k)[k:, 1:-1]    # rows [h-k, h)
+            lb = evolve_band(p[:, :3], k)[:, 1:2]            # word col 0
+            rb = evolve_band(p[:, nw - 1 :], k)[:, 1:2]      # word col nw-1
+            core = jnp.concatenate([tb, q, bb], axis=0)      # (h, nw)
+            return jnp.concatenate([lb, core[:, 1 : nw - 1], rb], axis=1)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+        def local_step(local):
+            h, nw = local.shape
+            if overlap and h >= 2 * k and nw >= 2:
+                return body_overlap(local)
+            return body_exchange_all(local)
 
         return local_step
 

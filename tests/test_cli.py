@@ -76,6 +76,28 @@ def test_config_rejects_ghost_deeper_than_tile():
         GolConfig(rows=4, cols=32, steps=1, mesh_shape=(1, 1), comm_every=8)
 
 
+def test_cli_overlap_matches_oracle(tmp_path):
+    # word-aligned shard width (256/4 = 64 cols/shard) → packed engine,
+    # so --overlap actually selects the stitched-band stepper
+    rc = main([
+        "32", "256", "8", "16", "--backend", "tpu", "--save", "--quiet",
+        "--out-dir", str(tmp_path), "--name", "ov", "--seed", "5",
+        "--mesh", "2x4", "--overlap", "--comm-every", "2",
+    ])
+    assert rc == 0
+    final = golio.assemble(str(tmp_path), "ov", 16)
+    ref = evolve_np(init_tile_np(32, 256, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_cli_overlap_rejects_dead_boundary(tmp_path):
+    rc = main([
+        "32", "32", "8", "16", "--backend", "tpu", "--out-dir", str(tmp_path),
+        "--overlap", "--boundary", "dead", "--quiet",
+    ])
+    assert rc == 2
+
+
 def test_cli_snapshot_series(tmp_path):
     run_cli(tmp_path, "series", "serial")
     assert golio.list_snapshot_iterations(str(tmp_path), "series") == [0, 8, 16]

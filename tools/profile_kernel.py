@@ -56,6 +56,8 @@ def main():
     print(f"VPU u32 roof (xor/shift/add chain): {roof/1e12:.2f} Tops/s")
 
     gens = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    if not 1 <= gens <= 16:
+        sys.exit(f"usage: profile_kernel.py [gens in 1..16], got {gens}")
 
     @functools.partial(jax.jit, static_argnames=("steps", "g"))
     def evolve_pop(p, steps, g):

@@ -68,6 +68,9 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--comm-every", type=int, default=1,
                    help="generations per halo exchange (1..16)")
+    p.add_argument("--overlap", action="store_true",
+                   help="overlap ppermute with interior compute (packed "
+                   "engine, periodic boundary)")
     p.add_argument("--out-dir", default=".")
     p.add_argument("--time-file", default="sweep")
     args = p.parse_args(argv)
@@ -81,6 +84,10 @@ def main(argv=None) -> int:
     )
     from mpi_tpu.utils.timing import PhaseTimer, write_reports
 
+    if not 1 <= args.comm_every <= 16:
+        sys.exit(f"error: --comm-every must be in 1..16, got {args.comm_every}")
+    if args.overlap and args.boundary != "periodic":
+        sys.exit("error: --overlap requires --boundary periodic")
     os.makedirs(args.out_dir, exist_ok=True)
     rule = rule_from_name(args.rule)
     n_total = len(jax.devices())
@@ -100,7 +107,8 @@ def main(argv=None) -> int:
         if packed:
             grid = sharded_bit_init(mesh, rows, cols, args.seed)
             evolve = make_sharded_bit_stepper(
-                mesh, rule, args.boundary, gens_per_exchange=args.comm_every
+                mesh, rule, args.boundary, gens_per_exchange=args.comm_every,
+                overlap=args.overlap,
             )
         else:
             grid = sharded_init(mesh, rows, cols, args.seed)
@@ -124,6 +132,7 @@ def main(argv=None) -> int:
             "devices": n, "mesh": list(shape), "grid": [rows, cols],
             "steps": args.steps, "engine": "bitpacked" if packed else "dense",
             "comm_every": args.comm_every,
+            "overlap": bool(args.overlap and packed),
             "cells_per_sec": round(cps, 1),
             "weak_scaling_efficiency": round(eff, 4),
         }))

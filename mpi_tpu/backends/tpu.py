@@ -113,21 +113,24 @@ def run_tpu(
     packed_mode = config.rule.radius == 1 and (config.cols // mj) % WORD == 0
     if config.overlap and mi * mj > 1:
         # fail fast instead of silently running without the requested
-        # overlap: the stitched-band stepper needs the packed engine and
-        # tiles tall enough for its K-row edge bands
+        # overlap: tiles must be big enough for the stitched edge bands
         from mpi_tpu.config import ConfigError
 
-        if not packed_mode:
-            raise ConfigError(
-                f"--overlap needs the packed engine: per-shard width "
-                f"{config.cols // mj} is not a multiple of {WORD}"
-            )
-        if config.rows // mi < 2 * config.comm_every or (config.cols // mj) // WORD < 2:
-            raise ConfigError(
-                f"--overlap needs tiles >= {2 * config.comm_every} rows x "
-                f"{2 * WORD} cols (got "
-                f"{config.rows // mi}x{config.cols // mj})"
-            )
+        tile_r, tile_c = config.rows // mi, config.cols // mj
+        if packed_mode:
+            if tile_r < 2 * config.comm_every or tile_c < 2 * WORD:
+                raise ConfigError(
+                    f"--overlap needs tiles >= {2 * config.comm_every} rows "
+                    f"x {2 * WORD} cols (got {tile_r}x{tile_c})"
+                )
+        else:
+            d = 2 * config.comm_every * config.rule.radius
+            if min(tile_r, tile_c) < d:
+                raise ConfigError(
+                    f"--overlap needs tiles >= {d}x{d} for radius "
+                    f"{config.rule.radius} x comm_every {config.comm_every} "
+                    f"bands (got {tile_r}x{tile_c})"
+                )
     if packed_mode:
         from mpi_tpu.parallel.step import (
             sharded_bit_init, make_sharded_unpacker,
@@ -141,7 +144,7 @@ def run_tpu(
     else:
         evolve = make_sharded_stepper(
             mesh, config.rule, config.boundary,
-            gens_per_exchange=config.comm_every,
+            gens_per_exchange=config.comm_every, overlap=config.overlap,
         )
         if initial is not None:
             grid = jax.device_put(np.asarray(initial, dtype=np.uint8), grid_sharding(mesh))

@@ -69,7 +69,7 @@ def build_parser() -> argparse.ArgumentParser:
                    "a single device K is the Pallas kernel's temporal-"
                    "blocking depth (generations per HBM round-trip)")
     p.add_argument("--overlap", action="store_true",
-                   help="tpu backend, packed engine, periodic boundary: "
+                   help="tpu backend, periodic boundary: "
                    "overlap the ppermute halo exchange with interior "
                    "compute (edge bands recomputed from the halo and "
                    "stitched in; the comm/compute overlap the reference's "

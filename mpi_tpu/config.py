@@ -74,7 +74,7 @@ class GolConfig:
     out_dir: str = "."
     workers: int = 0                 # native backend threads; 0 = auto
     comm_every: int = 1              # TPU: generations per halo exchange (1..16)
-    overlap: bool = False            # TPU packed engine: overlap ppermute with interior compute
+    overlap: bool = False            # TPU backend (packed or dense): overlap ppermute with interior compute
 
     def __post_init__(self):
         if self.rows <= 0 or self.cols <= 0:

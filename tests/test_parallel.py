@@ -197,18 +197,58 @@ def test_sharded_bit_overlap_rejects_dead_boundary():
         make_sharded_bit_stepper(mesh, LIFE, "dead", overlap=True)
 
 
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1)])
+@pytest.mark.parametrize("K", [1, 2])
+def test_sharded_dense_overlap(mesh_shape, K):
+    # dense stitched-band overlap with a radius-2 rule (d = K*r fringe)
+    from mpi_tpu.models.rules import Rule
+
+    r2 = Rule("r2ov", frozenset({7, 8}), frozenset(range(5, 10)), radius=2)
+    mesh = make_mesh(mesh_shape)
+    g0 = init_tile_np(64, 64, seed=61)
+    evolve = make_sharded_stepper(mesh, r2, "periodic",
+                                  gens_per_exchange=K, overlap=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(evolve(g, 2 * K + 1)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 2 * K + 1, r2, "periodic"))
+
+
+def test_sharded_dense_overlap_life():
+    mesh = make_mesh((2, 4))
+    g0 = init_tile_np(48, 96, seed=67)
+    evolve = make_sharded_stepper(mesh, LIFE, "periodic",
+                                  gens_per_exchange=4, overlap=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(evolve(g, 9)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 9, LIFE, "periodic"))
+
+
 def test_run_tpu_overlap_fails_fast_when_not_applicable():
-    # requested overlap must not silently degrade to the dense engine or
-    # to tiles too small for the stitched bands
+    # requested overlap must not silently degrade on tiles too small for
+    # the stitched bands (packed and dense engines)
     from mpi_tpu.backends.tpu import run_tpu
     from mpi_tpu.config import ConfigError, GolConfig
 
-    with pytest.raises(ConfigError):  # 40 cols/shard not word-aligned
-        run_tpu(GolConfig(rows=64, cols=320, steps=1, overlap=True,
-                          mesh_shape=(1, 8)))
-    with pytest.raises(ConfigError):  # 8-row tiles < 2*K band depth
+    with pytest.raises(ConfigError):  # packed: 8-row tiles < 2*K bands
         run_tpu(GolConfig(rows=64, cols=256, steps=8, overlap=True,
                           comm_every=8, mesh_shape=(8, 1)))
+    with pytest.raises(ConfigError):  # dense: 8-row tiles < 2*K*r bands
+        run_tpu(GolConfig(rows=64, cols=320, steps=8, overlap=True,
+                          comm_every=8, mesh_shape=(8, 1)))
+
+
+def test_run_tpu_dense_overlap_matches_oracle():
+    # non-word-aligned shard width → dense engine with stitched-band
+    # overlap, end-to-end through run_tpu
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    cfg = GolConfig(rows=64, cols=320, steps=9, seed=71, overlap=True,
+                    comm_every=3, mesh_shape=(1, 8))
+    out = run_tpu(cfg)
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(64, 320, seed=71), 9, LIFE, "periodic")
+    )
 
 
 def test_sharded_gens_remainder_steps():

@@ -69,8 +69,8 @@ def main(argv=None) -> int:
     p.add_argument("--comm-every", type=int, default=1,
                    help="generations per halo exchange (1..16)")
     p.add_argument("--overlap", action="store_true",
-                   help="overlap ppermute with interior compute (packed "
-                   "engine, periodic boundary)")
+                   help="overlap ppermute with interior compute (periodic "
+                   "boundary; packed or dense engine)")
     p.add_argument("--out-dir", default=".")
     p.add_argument("--time-file", default="sweep")
     args = p.parse_args(argv)
@@ -104,6 +104,14 @@ def main(argv=None) -> int:
         packed = rule.radius == 1 and (cols // shape[1]) % WORD == 0
 
         timer = PhaseTimer()
+        # does the stepper actually run its overlap body on these tiles,
+        # or fall back to exchange-all?  (report the effective mode)
+        if packed:
+            overlap_active = (args.overlap and args.tile >= 2 * args.comm_every
+                              and args.tile // WORD >= 2)
+        else:
+            overlap_active = (args.overlap
+                              and args.tile >= 2 * args.comm_every * rule.radius)
         if packed:
             grid = sharded_bit_init(mesh, rows, cols, args.seed)
             evolve = make_sharded_bit_stepper(
@@ -113,7 +121,8 @@ def main(argv=None) -> int:
         else:
             grid = sharded_init(mesh, rows, cols, args.seed)
             evolve = make_sharded_stepper(
-                mesh, rule, args.boundary, gens_per_exchange=args.comm_every
+                mesh, rule, args.boundary, gens_per_exchange=args.comm_every,
+                overlap=args.overlap,
             )
         compiled = evolve.lower(grid, args.steps).compile()
         jax.block_until_ready(grid)
@@ -132,7 +141,7 @@ def main(argv=None) -> int:
             "devices": n, "mesh": list(shape), "grid": [rows, cols],
             "steps": args.steps, "engine": "bitpacked" if packed else "dense",
             "comm_every": args.comm_every,
-            "overlap": bool(args.overlap and packed),
+            "overlap": bool(args.overlap and overlap_active),
             "cells_per_sec": round(cps, 1),
             "weak_scaling_efficiency": round(eff, 4),
         }))

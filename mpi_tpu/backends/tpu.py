@@ -52,13 +52,26 @@ def _shard_tiles(grid: jax.Array) -> List[Tuple[int, np.ndarray, int, int]]:
     return out
 
 
+def _pallas_single_device_mode():
+    """(use, interpret) for the single-device fused-kernel dispatch: a real
+    TPU runs the kernels natively; off-TPU the kernels are only taken when
+    MPI_TPU_PALLAS_INTERPRET=1 (tests) — interpret-mode Pallas is far too
+    slow for production runs, which keep the compiled XLA path."""
+    import os
+
+    if jax.devices()[0].platform == "tpu":
+        return True, False
+    return os.environ.get("MPI_TPU_PALLAS_INTERPRET") == "1", True
+
+
 def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
     """Packed-engine stepper: on a single device the fused Pallas SWAR
     kernel (ops/pallas_bitlife.py) replaces the shard_map/XLA path — no
-    halo exchange exists, and ``comm_every`` becomes the kernel's
-    temporal-blocking depth (generations per HBM round-trip).  Off-TPU
-    the kernel runs in interpret mode (tests); multi-device meshes use
-    the ppermute stepper."""
+    halo exchange exists, ``comm_every`` becomes the kernel's
+    temporal-blocking depth (generations per HBM round-trip), and a
+    requested ``overlap`` is vacuous (no collective to overlap with), so
+    the fused kernel is taken regardless of the flag.  Multi-device
+    meshes (and off-TPU production runs) use the ppermute stepper."""
     from mpi_tpu.parallel.step import make_sharded_bit_stepper
 
     if n_devices == 1:
@@ -66,13 +79,38 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
 
         gens = config.comm_every
         shape = (config.rows, config.cols)
+        use, interpret = _pallas_single_device_mode()
         # (birth-on-0 with gens > 1 is already rejected by GolConfig)
-        if supports(shape, config.rule, gens=gens):
-            interpret = jax.devices()[0].platform != "tpu"
+        if use and supports(shape, config.rule, gens=gens):
             return make_pallas_bit_stepper(
                 config.rule, config.boundary, interpret=interpret, gens=gens
             )
     return make_sharded_bit_stepper(
+        mesh, config.rule, config.boundary,
+        gens_per_exchange=config.comm_every, overlap=config.overlap,
+    )
+
+
+def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int):
+    """Dense-engine stepper: on a single device the fused dense Pallas
+    kernel (ops/pallas_stencil.py, one HBM read + one write per cell per
+    step) replaces the shard_map/XLA path, which would otherwise serve a
+    higher-radius single-chip run with the slowest engine.  The kernel has
+    no temporal blocking, so an explicit --comm-every > 1 keeps the
+    sharded stepper (whose K-deep self-exchange honors it) instead of
+    being silently dropped; ``overlap`` is vacuous on one device (no
+    collective to overlap with — same contract as the packed engine) and
+    does not affect the dispatch.  Multi-device meshes (and off-TPU
+    production runs) use the ppermute stepper."""
+    if n_devices == 1 and config.comm_every == 1:
+        from mpi_tpu.ops.pallas_stencil import make_pallas_stepper, supports
+
+        use, interpret = _pallas_single_device_mode()
+        if use and supports((config.rows, config.cols), config.rule):
+            return make_pallas_stepper(
+                config.rule, config.boundary, interpret=interpret
+            )
+    return make_sharded_stepper(
         mesh, config.rule, config.boundary,
         gens_per_exchange=config.comm_every, overlap=config.overlap,
     )
@@ -142,10 +180,7 @@ def run_tpu(
         else:
             grid = sharded_bit_init(mesh, config.rows, config.cols, config.seed)
     else:
-        evolve = make_sharded_stepper(
-            mesh, config.rule, config.boundary,
-            gens_per_exchange=config.comm_every, overlap=config.overlap,
-        )
+        evolve = _pick_dense_evolve(config, mesh, mi * mj)
         if initial is not None:
             grid = jax.device_put(np.asarray(initial, dtype=np.uint8), grid_sharding(mesh))
         else:

@@ -66,10 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "generations between collectives (communication-avoiding; "
                    "the deep-halo optimization the reference's per-step "
                    "barrier+exchange loop leaves out, main.cpp:291-305); on "
-                   "a single device K is the Pallas kernel's temporal-"
-                   "blocking depth (generations per HBM round-trip)")
+                   "a single TPU device with the packed (SWAR) engine K is "
+                   "the Pallas kernel's temporal-blocking depth "
+                   "(generations per HBM round-trip)")
     p.add_argument("--overlap", action="store_true",
-                   help="tpu backend, periodic boundary: "
+                   help="tpu backend: "
                    "overlap the ppermute halo exchange with interior "
                    "compute (edge bands recomputed from the halo and "
                    "stitched in; the comm/compute overlap the reference's "
@@ -160,6 +161,10 @@ def _run(args) -> int:
         overlap=args.overlap,
     )
     if args.strict:
+        # backend-independent checks (square grid, any typed --mesh) fail
+        # here, before any side effect — no out-dir creation, no snapshot
+        # load, no device init (jax.devices can hang on a dead tunnel);
+        # the effective auto-chosen decomposition is re-checked below.
         config.validate_strict()
 
     import os
@@ -201,15 +206,27 @@ def _run(args) -> int:
     if config.backend in ("serial", "cpp"):
         processes = 1
         tiles_shape = (1, 1)
+        effective_mesh = (1, 1)
     elif config.backend == "cpp-par":
         from mpi_tpu.backends.cpp import plan_tiles
 
         tiles_shape = plan_tiles((config.rows, config.cols), config.workers, rule.radius)
         processes = tiles_shape[0] * tiles_shape[1]
+        effective_mesh = tiles_shape
     else:
         from mpi_tpu.backends.tpu import device_count
 
-        processes = device_count() if mesh_shape is None else mesh_shape[0] * mesh_shape[1]
+        if mesh_shape is None:
+            from mpi_tpu.parallel.mesh import choose_mesh_shape
+
+            effective_mesh = choose_mesh_shape(device_count())
+        else:
+            effective_mesh = mesh_shape
+        processes = effective_mesh[0] * effective_mesh[1]
+    if args.strict:
+        # judged against the decomposition that will actually run, not just
+        # an explicit --mesh (reference rules, main.cpp:194-200)
+        config.validate_strict(effective_mesh)
 
     golio.write_master(
         args.out_dir, name, config.rows, config.cols,

@@ -74,7 +74,7 @@ class GolConfig:
     out_dir: str = "."
     workers: int = 0                 # native backend threads; 0 = auto
     comm_every: int = 1              # TPU: generations per halo exchange (1..16)
-    overlap: bool = False            # TPU backend (packed or dense): overlap ppermute with interior compute
+    overlap: bool = False            # TPU backend (packed or dense, either boundary): overlap ppermute with interior compute
 
     def __post_init__(self):
         if self.rows <= 0 or self.cols <= 0:
@@ -98,30 +98,42 @@ class GolConfig:
             )
         if self.comm_every > 1 and 0 in self.rule.birth:
             raise ConfigError("comm_every > 1 requires a rule without birth-on-0")
-        if self.overlap:
+        if self.overlap and self.backend != "tpu":
+            raise ConfigError("overlap applies to the tpu backend only")
+        if self.mesh_shape is not None:
             if self.backend != "tpu":
-                raise ConfigError("overlap applies to the tpu backend only")
-            if self.boundary != "periodic":
-                raise ConfigError("overlap requires the periodic boundary")
-        if self.mesh_shape is not None and self.backend == "tpu":
-            # only the tpu backend shards over the mesh / slices ghost
-            # rings; other backends ignore mesh_shape entirely
+                # other backends would silently ignore it (cpp-par
+                # decomposes via --workers) — fail fast instead
+                raise ConfigError(
+                    f"mesh_shape applies to the tpu backend only "
+                    f"(got backend={self.backend!r})"
+                )
             validate_mesh(
                 self.rows, self.cols, self.mesh_shape,
                 self.rule.radius * self.comm_every,
             )
 
-    def validate_strict(self) -> None:
+    def validate_strict(self, effective_mesh: Optional[Tuple[int, int]] = None) -> None:
         """Enforce the reference's exact preconditions (``main.cpp:195``):
-        square grid, square mesh, divisibility, tile >= 4 cells/side."""
+        square grid, square mesh, divisibility, tile >= 4 cells/side.
+
+        ``effective_mesh`` is the decomposition the run will actually use
+        (the auto-chosen device mesh, or the cpp-par tile plan) — strict
+        mode must judge what runs, not just what was typed (an auto 2x4
+        mesh is not a perfect square even though no ``--mesh`` flag was
+        given), so when provided it wins over ``mesh_shape``."""
         if self.rows != self.cols:
             raise ConfigError("strict mode: grid must be square")
-        if self.mesh_shape is not None:
-            mi, mj = self.mesh_shape
+        mesh = effective_mesh if effective_mesh is not None else self.mesh_shape
+        if mesh is not None:
+            mi, mj = mesh
             p = mi * mj
             z = math.isqrt(p)
             if z * z != p or mi != mj:
-                raise ConfigError("strict mode: device count must be a perfect square mesh")
+                raise ConfigError(
+                    f"strict mode: device count must be a perfect square mesh "
+                    f"(effective mesh {mi}x{mj})"
+                )
             if self.rows % mi:
                 raise ConfigError("strict mode: mesh must divide rows")
             if self.rows // mi < 4:

@@ -221,22 +221,18 @@ def pallas_step(
     )(grid)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("rule", "boundary", "steps", "interpret"), donate_argnums=0
-)
-def _evolve_pallas(grid, rule, boundary, steps, interpret):
-    def body(g, _):
-        return pallas_step(g, rule, boundary, interpret=interpret), None
-
-    out, _ = lax.scan(body, grid, None, length=steps)
-    return out
-
-
 def make_pallas_stepper(rule: Rule = LIFE, boundary: str = "periodic", interpret: bool = False):
-    """evolve(grid, steps) using the fused kernel per step."""
+    """evolve(grid, steps) using the fused kernel per step; jitted with a
+    donated carry so ``evolve.lower`` works for ahead-of-time compilation
+    (the same contract as ``pallas_bitlife.make_pallas_bit_stepper``)."""
 
+    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
     def evolve(grid: jax.Array, steps: int) -> jax.Array:
-        return _evolve_pallas(grid, rule, boundary, steps, interpret)
+        def body(g, _):
+            return pallas_step(g, rule, boundary, interpret=interpret), None
+
+        out, _ = lax.scan(body, grid, None, length=steps)
+        return out
 
     return evolve
 

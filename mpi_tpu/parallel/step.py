@@ -75,10 +75,13 @@ def make_sharded_stepper(
     overlap the reference leaves on the table with its per-step barrier,
     ``/root/reference/main.cpp:297``).
 
-    ``overlap=True`` (periodic only): the tile interior evolves its K
-    generations from local data alone while the ppermute is in flight
-    (no data dependency → XLA overlaps them); only the K·r-deep edge
-    bands are recomputed from the exchanged halo and stitched in.
+    ``overlap=True``: the tile interior evolves its K generations from
+    local data alone while the ppermute is in flight (no data dependency →
+    XLA overlaps them); only the K·r-deep edge bands are recomputed from
+    the exchanged halo and stitched in.  Dead boundary: the bands'
+    outside-global fringe cells are re-killed each generation (the same
+    discipline as the non-overlap path), masked per band side so a band's
+    interior-facing side is never touched.
     """
     K = gens_per_exchange
     r = rule.radius
@@ -86,16 +89,24 @@ def make_sharded_stepper(
         raise ValueError(f"gens_per_exchange must be >= 1, got {K}")
     if K > 1 and 0 in rule.birth:
         raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
-    if overlap and boundary != "periodic":
-        raise ValueError("overlap=True supports the periodic boundary only")
     spec = PartitionSpec(*axes)
     dead = boundary != "periodic"
 
-    def evolve_trapezoid(band, k):
-        """k generations, each trimming r cells per side (zeros beyond)."""
-        for _ in range(k):
+    def evolve_trapezoid(band, k, kill_sides=(0, 0, 0, 0)):
+        """k generations, each trimming r cells per side (zeros beyond).
+        ``kill_sides`` (top, bottom, left, right booleans): band sides whose
+        still-remaining fringe lies outside the global grid on the mesh-edge
+        shards — re-kill it each generation (dead boundary), so "births"
+        in ghost space never feed back into real cells."""
+        for g in range(k):
             counts = counts_from_padded(band, r)
             band = apply_rule(band[r:-r, r:-r], counts, rule)
+            m = (k - 1 - g) * r
+            if dead and m and any(kill_sides):
+                t, b, l, ri = kill_sides
+                band = _kill_outside_global(
+                    band, axes, (m * t, m * b, m * l, m * ri)
+                )
         return band
 
     def make_local(k):
@@ -120,14 +131,19 @@ def make_sharded_stepper(
             padded = exchange_halo(local, d, boundary, axes)  # (h+2d, w+2d)
             # interior (rows/cols [d, size-d)) from local data alone —
             # independent of the ppermute, so the two overlap; the
-            # invalid outer-d columns are replaced by lb/rb below
+            # invalid outer-d columns are replaced by lb/rb below.  (No
+            # dead-boundary kill needed: every kept cell is >= d from the
+            # tile edge, out of reach of the zero-pad fringe.)
             q = evolve_trapezoid(jnp.pad(local, d), k)[d:-d, :]
             # edge bands from the exchanged halo, full cross dimension so
-            # corners are exact; band output coord i = input coord i + d
-            tb = evolve_trapezoid(padded[: 4 * d], k)[:d]        # rows [0, d)
-            bb = evolve_trapezoid(padded[h - 2 * d :], k)[d:]    # rows [h-d, h)
-            lb = evolve_trapezoid(padded[:, : 4 * d], k)[:, :d]  # cols [0, d)
-            rb = evolve_trapezoid(padded[:, w - 2 * d :], k)[:, d:]
+            # corners are exact; band output coord i = input coord i + d.
+            # kill_sides: each band's outward + lateral sides can lie
+            # outside the global grid on edge shards; its inward side is
+            # always tile interior and must never be killed.
+            tb = evolve_trapezoid(padded[: 4 * d], k, (1, 0, 1, 1))[:d]
+            bb = evolve_trapezoid(padded[h - 2 * d :], k, (0, 1, 1, 1))[d:]
+            lb = evolve_trapezoid(padded[:, : 4 * d], k, (1, 1, 1, 0))[:, :d]
+            rb = evolve_trapezoid(padded[:, w - 2 * d :], k, (1, 1, 0, 1))[:, d:]
             core = jnp.concatenate([tb, q, bb], axis=0)          # (h, w)
             return jnp.concatenate(
                 [lb, core[:, d : w - d], rb], axis=1
@@ -163,7 +179,7 @@ def make_sharded_bit_stepper(
     the vertical fringe shrinks one row per generation, reaching exactly
     the local tile after K.  Collective count drops K×.
 
-    ``overlap=True`` (periodic only) removes the data dependency between
+    ``overlap=True`` removes the data dependency between
     the ppermute and the bulk of the stencil — the optimization the
     reference's barrier-then-exchange loop forgoes entirely
     (``/root/reference/main.cpp:297-299``): the tile interior evolves K
@@ -184,8 +200,6 @@ def make_sharded_bit_stepper(
         raise ValueError(f"gens_per_exchange must be in 1..16, got {K}")
     if K > 1 and 0 in rule.birth:
         raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
-    if overlap and boundary != "periodic":
-        raise ValueError("overlap=True supports the periodic boundary only")
     spec = PartitionSpec(*axes)
     periodic = boundary == "periodic"
 
@@ -201,11 +215,21 @@ def make_sharded_bit_stepper(
         f1n = jnp.concatenate([f1[:, 1:], zcol], axis=1)
         return bit_next(f0, f1, c0, c1, f0p, f1p, f0n, f1n, p[1 : n - 1], rule)
 
-    def evolve_band(band, k):
+    def evolve_band(band, k, kill_sides=(0, 0, 0, 0)):
         """k generations over a row band (zeros assumed past every edge);
-        each generation trims one row per side — trapezoid validity."""
-        for _ in range(k):
+        each generation trims one row per side — trapezoid validity.
+        ``kill_sides`` (top, bottom, left, right): band sides that lie
+        outside the global grid on mesh-edge shards, re-killed each
+        generation (dead boundary).  Row margins shrink with the trapezoid
+        ((k-1-g) rows); lateral margins are whole ghost word columns."""
+        for g in range(k):
             band = one_gen(band, rule)
+            if not periodic and any(kill_sides):
+                m = k - 1 - g
+                t, b, l, ri = kill_sides
+                margins = (m * t, m * b, l, ri)
+                if any(margins):
+                    band = _kill_outside_global(band, axes, margins)
         return band
 
     def make_local(k):
@@ -233,10 +257,12 @@ def make_sharded_bit_stepper(
             q = evolve_band(local, k)  # (h-2k, nw)
             # Edge bands from the exchanged halo (full padded width, so
             # their corners are exact): output row i = input row i+k.
-            tb = evolve_band(p[: 4 * k], k)[:k, 1:-1]        # tile rows [0, k)
-            bb = evolve_band(p[h - 2 * k :], k)[k:, 1:-1]    # rows [h-k, h)
-            lb = evolve_band(p[:, :3], k)[:, 1:2]            # word col 0
-            rb = evolve_band(p[:, nw - 1 :], k)[:, 1:2]      # word col nw-1
+            # kill_sides: outward + lateral sides only — a band's
+            # interior-facing side is tile interior even on edge shards.
+            tb = evolve_band(p[: 4 * k], k, (1, 0, 1, 1))[:k, 1:-1]
+            bb = evolve_band(p[h - 2 * k :], k, (0, 1, 1, 1))[k:, 1:-1]
+            lb = evolve_band(p[:, :3], k, (1, 1, 1, 0))[:, 1:2]
+            rb = evolve_band(p[:, nw - 1 :], k, (1, 1, 0, 1))[:, 1:2]
             core = jnp.concatenate([tb, q, bb], axis=0)      # (h, nw)
             return jnp.concatenate([lb, core[:, 1 : nw - 1], rb], axis=1)
 

@@ -90,12 +90,20 @@ def test_cli_overlap_matches_oracle(tmp_path):
     np.testing.assert_array_equal(final, ref)
 
 
-def test_cli_overlap_rejects_dead_boundary(tmp_path):
+def test_cli_overlap_dead_boundary_matches_oracle(tmp_path):
+    # --overlap now covers the dead boundary too (VERDICT r1 item 5): the
+    # reference MPI program's non-periodic semantics get the flagship
+    # multichip optimization
     rc = main([
-        "32", "32", "8", "16", "--backend", "tpu", "--out-dir", str(tmp_path),
-        "--overlap", "--boundary", "dead", "--quiet",
+        "32", "256", "8", "16", "--backend", "tpu", "--save", "--quiet",
+        "--out-dir", str(tmp_path), "--name", "ovd", "--seed", "5",
+        "--mesh", "2x4", "--overlap", "--comm-every", "2",
+        "--boundary", "dead",
     ])
-    assert rc == 2
+    assert rc == 0
+    final = golio.assemble(str(tmp_path), "ovd", 16)
+    ref = evolve_np(init_tile_np(32, 256, seed=5), 16, LIFE, "dead")
+    np.testing.assert_array_equal(final, ref)
 
 
 def test_cli_snapshot_series(tmp_path):
@@ -225,3 +233,33 @@ def test_native_malformed_flag_exits_cleanly():
     )
     assert r.returncode == 2
     assert "invalid integer" in r.stderr
+
+
+def test_cli_strict_validates_effective_mesh(tmp_path):
+    # 8 virtual devices auto-factor to a 2x4 mesh — not a perfect square,
+    # so strict mode must reject a tpu run even with --mesh omitted
+    # (VERDICT r1 item 9; reference rules main.cpp:194-200).
+    rc = main(["32", "32", "8", "4", "--backend", "tpu", "--strict",
+               "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2
+    # an explicit square mesh on the same grid passes
+    rc = main(["32", "32", "8", "4", "--backend", "tpu", "--strict",
+               "--mesh", "2x2", "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 0
+
+
+def test_cli_mesh_rejected_for_non_tpu_backend(tmp_path):
+    # --mesh would be silently ignored by cpp-par/serial (they decompose
+    # via --workers / not at all) — must fail fast instead
+    rc = main(["32", "32", "8", "4", "--backend", "serial", "--mesh", "2x2",
+               "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2
+
+
+def test_cli_strict_fails_before_side_effects(tmp_path):
+    # an invalid strict config must not create the out dir
+    out = tmp_path / "nonexistent"
+    rc = main(["100", "50", "10", "10", "--backend", "serial", "--strict",
+               "--out-dir", str(out), "--quiet"])
+    assert rc == 2
+    assert not out.exists()

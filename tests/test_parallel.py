@@ -189,12 +189,24 @@ def test_sharded_bit_overlap_small_tile_fallback():
     np.testing.assert_array_equal(out, ref)
 
 
-def test_sharded_bit_overlap_rejects_dead_boundary():
-    from mpi_tpu.parallel.step import make_sharded_bit_stepper
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1), (1, 8)])
+@pytest.mark.parametrize("K", [1, 3, 8])
+def test_sharded_bit_overlap_dead_boundary(mesh_shape, K):
+    # dead boundary + overlap (VERDICT r1 item 5): stitched bands re-kill
+    # their outside-global fringe each generation, so the result matches
+    # the oracle on edge shards too
+    from mpi_tpu.parallel.step import (
+        make_sharded_bit_stepper, sharded_bit_init, sharded_unpack,
+    )
 
-    mesh = make_mesh((2, 4))
-    with pytest.raises(ValueError):
-        make_sharded_bit_stepper(mesh, LIFE, "dead", overlap=True)
+    mesh = make_mesh(mesh_shape)
+    R, C = 64, 256
+    p = sharded_bit_init(mesh, R, C, seed=53)
+    ev = make_sharded_bit_stepper(mesh, LIFE, "dead",
+                                  gens_per_exchange=K, overlap=True)
+    out = np.asarray(jax.device_get(sharded_unpack(mesh, ev(p, 3 * K + 1))))
+    ref = evolve_np(init_tile_np(R, C, seed=53), 3 * K + 1, LIFE, "dead")
+    np.testing.assert_array_equal(out, ref)
 
 
 @pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1)])
@@ -221,6 +233,35 @@ def test_sharded_dense_overlap_life():
     g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
     out = np.asarray(jax.device_get(evolve(g, 9)))
     np.testing.assert_array_equal(out, evolve_np(g0, 9, LIFE, "periodic"))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1)])
+@pytest.mark.parametrize("K", [1, 2, 4])
+def test_sharded_dense_overlap_dead_boundary(mesh_shape, K):
+    # dead boundary + dense overlap (VERDICT r1 item 5), LIFE radius 1
+    mesh = make_mesh(mesh_shape)
+    g0 = init_tile_np(48, 96, seed=67)
+    evolve = make_sharded_stepper(mesh, LIFE, "dead",
+                                  gens_per_exchange=K, overlap=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(evolve(g, 2 * K + 1)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 2 * K + 1, LIFE, "dead"))
+
+
+@pytest.mark.parametrize("K", [1, 2])
+def test_sharded_dense_overlap_dead_radius2(K):
+    # radius-2 rule, dead boundary, overlap: d = K*r bands with per-gen
+    # outside-global kill at margins m = (K-1-g)*r
+    from mpi_tpu.models.rules import Rule
+
+    r2 = Rule("r2ovd", frozenset({7, 8}), frozenset(range(5, 10)), radius=2)
+    mesh = make_mesh((2, 4))
+    g0 = init_tile_np(64, 64, seed=61)
+    evolve = make_sharded_stepper(mesh, r2, "dead",
+                                  gens_per_exchange=K, overlap=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(evolve(g, 2 * K + 1)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 2 * K + 1, r2, "dead"))
 
 
 def test_run_tpu_overlap_fails_fast_when_not_applicable():
@@ -277,17 +318,48 @@ def test_run_tpu_packed_dispatch(tmp_path):
     )
 
 
-def test_run_tpu_single_device_pallas_path(tmp_path):
+def test_run_tpu_single_device_pallas_path(tmp_path, monkeypatch):
     # 1x1 mesh + lane-aligned width → the fused Pallas SWAR kernel (in
-    # interpret mode off-TPU), with comm_every as temporal-blocking depth
+    # interpret mode, opted in via the test env flag — production off-TPU
+    # runs keep the compiled XLA path), comm_every as temporal blocking
     from mpi_tpu.backends.tpu import run_tpu
     from mpi_tpu.config import GolConfig
 
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
     cfg = GolConfig(rows=16, cols=4096, steps=7, seed=11, comm_every=3,
                     mesh_shape=(1, 1))
     out = run_tpu(cfg)
     np.testing.assert_array_equal(
         out, evolve_np(init_tile_np(16, 4096, seed=11), 7, LIFE, "periodic")
+    )
+
+
+def test_run_tpu_single_device_off_tpu_keeps_xla_path(monkeypatch):
+    # without the opt-in flag, an off-TPU single-device run must NOT take
+    # interpret-mode Pallas (orders of magnitude too slow for real runs)
+    import mpi_tpu.ops.pallas_bitlife as pb
+    import mpi_tpu.ops.pallas_stencil as ps
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+    from mpi_tpu.models.rules import rule_from_name
+
+    monkeypatch.delenv("MPI_TPU_PALLAS_INTERPRET", raising=False)
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("interpret-mode Pallas must not run in production")
+
+    monkeypatch.setattr(pb, "pallas_bit_step", boom)
+    monkeypatch.setattr(ps, "pallas_step", boom)
+    out = run_tpu(GolConfig(rows=16, cols=4096, steps=2, seed=11,
+                            mesh_shape=(1, 1)))
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(16, 4096, seed=11), 2, LIFE, "periodic")
+    )
+    r2 = rule_from_name("R2,B10-13,S8-12")
+    out = run_tpu(GolConfig(rows=32, cols=128, steps=2, seed=5, rule=r2,
+                            mesh_shape=(1, 1)))
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 128, seed=5), 2, r2, "periodic")
     )
 
 
@@ -302,4 +374,56 @@ def test_run_tpu_packed_comm_every(tmp_path):
     out = run_tpu(cfg)
     np.testing.assert_array_equal(
         out, evolve_np(init_tile_np(64, 256, seed=3), 14, LIFE, "dead")
+    )
+
+
+def test_run_tpu_single_device_dense_pallas_path(monkeypatch):
+    # 1x1 mesh + radius-2 rule (not packable: SWAR is radius-1 only) +
+    # lane-aligned width → run_tpu must dispatch the fused dense Pallas
+    # kernel (interpret mode off-TPU), not the XLA shard_map path, and
+    # match the oracle (VERDICT r1 item 2).
+    import mpi_tpu.ops.pallas_stencil as ps
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+    from mpi_tpu.models.rules import rule_from_name
+
+    rule = rule_from_name("R2,B10-13,S8-12")
+    calls = []
+    real = ps.pallas_step
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(ps, "pallas_step", spy)
+    cfg = GolConfig(rows=32, cols=128, steps=3, seed=5, rule=rule,
+                    mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    assert calls, "single-device dense run must use the fused Pallas kernel"
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 128, seed=5), 3, rule, "periodic")
+    )
+
+
+def test_run_tpu_multi_device_dense_keeps_sharded_path(monkeypatch):
+    # >1 device: the dense branch must keep the ppermute stepper (the
+    # single-device Pallas kernel has no halo exchange).
+    import mpi_tpu.ops.pallas_stencil as ps
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+    from mpi_tpu.models.rules import rule_from_name
+
+    rule = rule_from_name("R2,B10-13,S8-12")
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("dense Pallas kernel must not run on a 2x4 mesh")
+
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(ps, "pallas_step", boom)
+    cfg = GolConfig(rows=32, cols=128, steps=2, seed=5, rule=rule,
+                    mesh_shape=(2, 4))
+    out = run_tpu(cfg)
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 128, seed=5), 2, rule, "periodic")
     )

@@ -48,21 +48,13 @@ CPU_STEPS = 16
 CPU_TIMEOUT_S = 600
 
 
-def _force_cpu_if_asked() -> None:
-    # The axon sitecustomize pins jax_platforms at interpreter start, which
-    # trumps JAX_PLATFORMS; the config update is the only working override
-    # (same trick as tests/conftest.py).
-    if os.environ.get("MPI_TPU_BENCH_FORCE_CPU"):
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
-
 def probe() -> None:
     """Touch the device once; prints the platform name."""
     import jax
 
-    _force_cpu_if_asked()
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     print(json.dumps({"platform": jax.devices()[0].platform}))
 
 
@@ -79,7 +71,9 @@ def child(size: int, steps: int, gens: int) -> None:
     import numpy as np
     import jax
 
-    _force_cpu_if_asked()
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     import jax.numpy as jnp
     from jax import lax
 
@@ -88,6 +82,12 @@ def child(size: int, steps: int, gens: int) -> None:
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, supports
 
     platform = jax.devices()[0].platform
+    if platform != "tpu" and not os.environ.get("MPI_TPU_PLATFORM"):
+        # a transient TPU plugin-init failure makes JAX fall back to CPU
+        # silently; a CPU number must never masquerade as the TPU metric —
+        # fail so the parent's retry/backoff (or its explicit degraded CPU
+        # fallback, which sets MPI_TPU_PLATFORM) takes over
+        raise RuntimeError(f"expected tpu platform, got {platform!r}")
     if platform == "tpu":
         assert supports((size, size), LIFE, gens=gens)
 
@@ -123,7 +123,7 @@ def run_sub(argv, timeout: float, cpu: bool = False):
     env = dict(os.environ)
     if cpu:
         env["JAX_PLATFORMS"] = "cpu"
-        env["MPI_TPU_BENCH_FORCE_CPU"] = "1"
+        env["MPI_TPU_PLATFORM"] = "cpu"
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         proc = subprocess.run(
@@ -212,6 +212,8 @@ def _main_inner() -> None:
                 "tpu unreachable; cpu xla-swar fallback"
                 if not tpu_ok else "tpu runs failed; cpu xla-swar fallback"
             )
+    elif result.get("platform") != "tpu":
+        degraded = f"non-tpu platform {result.get('platform')!r}"
     elif result["size"] != SIZES[0]:
         degraded = f"fell back to {result['size']}^2 (larger sizes failed)"
 

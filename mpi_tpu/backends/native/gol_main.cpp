@@ -6,14 +6,18 @@
 //     rows cols iteration_gap iterations [time_file] [first]
 // (reference main.cpp:171-223) plus flags for what the reference
 // hardcoded: --workers N (multi-worker tile engine; the mpirun -np
-// analog), --boundary periodic|dead, --rule life|highlife|seeds|daynight,
-// --seed S, --save, --out-dir D, --name N.
+// analog), --boundary periodic|dead, --rule NAME (built-ins plus the
+// same 'B3/S23' / 'R5,B34-45,S33-57' grammar as models/rules.py, any
+// radius 1..7), --seed S, --save, --out-dir D, --name N.
 //
-// Emits the same .gol master/tile format as the Python CLI (golio.py), so
-// tools/gol_visualization.py and the parity tests consume its dumps
+// Emits the same .gol master/tile format as the Python CLI (golio.py) —
+// one tile per worker with global coordinates, like each MPI rank's own
+// dump in the reference (main.cpp:106-129) — so
+// tools/gol_visualization.py and the parity tests consume its output
 // directly, and appends the reference-schema 12-column timing CSV
 // (main.cpp:356-363) with correctly-labeled microseconds.
 
+#include <cctype>
 #include <chrono>
 #include <climits>
 #include <cstdint>
@@ -35,24 +39,127 @@ int gol_evolve_par(uint8_t*, int64_t, int64_t, int64_t, const uint8_t*,
 
 namespace {
 
-struct Rule {
-    const char* name;
-    uint8_t birth[9];
-    uint8_t survive[9];
+// An outer-totalistic rule as the engine consumes it: count-indexed birth/
+// survive tables of size (2r+1)^2 (the form models/rules.py `tables()`
+// produces for the ctypes path — one grammar, two front ends).
+struct ParsedRule {
+    int radius = 1;
+    std::vector<uint8_t> birth, survive;
 };
 
-// radius-1 built-ins (tables indexed by neighbor count 0..8)
-const Rule kRules[] = {
-    {"life",     {0,0,0,1,0,0,0,0,0}, {0,0,1,1,0,0,0,0,0}},
-    {"highlife", {0,0,0,1,0,0,1,0,0}, {0,0,1,1,0,0,0,0,0}},
-    {"seeds",    {0,0,1,0,0,0,0,0,0}, {0,0,0,0,0,0,0,0,0}},
-    {"daynight", {0,0,0,1,0,0,1,1,1}, {0,0,0,1,1,0,1,1,1}},
-};
-
-const Rule* find_rule(const std::string& n) {
-    for (const auto& r : kRules)
-        if (n == r.name) return &r;
+// Built-ins route through the same string grammar as the Python registry
+// (models/rules.py LIFE/HIGHLIFE/SEEDS/DAY_AND_NIGHT/BOSCO).
+const char* builtin_rule(const std::string& n) {
+    if (n == "life") return "b3/s23";
+    if (n == "highlife") return "b36/s23";
+    if (n == "seeds") return "b2/s";
+    if (n == "daynight") return "b3678/s34678";
+    if (n == "bosco") return "r5,b34-45,s33-57";
     return nullptr;
+}
+
+// "b<digits>/s<digits>" (radius 1) or "r<N>,b<ranges>,s<ranges>" where
+// ranges are '+'-joined "lo-hi" / single counts — mirrors
+// rules.rule_from_name exactly.  Returns false on parse/validation error.
+bool parse_rule(std::string s, ParsedRule& out, std::string& err) {
+    for (auto& c : s) c = (char)tolower(c);
+    if (const char* b = builtin_rule(s)) s = b;
+
+    // Non-digit characters are skipped (Python: `if ch.isdigit()`), but an
+    // out-of-range digit errors (Python: Rule.__post_init__ range check) —
+    // B9/S23 must fail the same way in both front ends.
+    auto add_counts_digits = [](const std::string& part, std::vector<uint8_t>& t) -> bool {
+        for (char c : part) {
+            if (c < '0' || c > '9') continue;
+            if ((size_t)(c - '0') >= t.size()) return false;
+            t[(size_t)(c - '0')] = 1;
+        }
+        return true;
+    };
+    // Strict integer pieces (Python's int() rejects trailing junk like
+    // "1a"; std::stol alone would parse the leading digits).
+    auto strict_long = [](const std::string& v, long& out) -> bool {
+        try {
+            size_t used = 0;
+            out = std::stol(v, &used);
+            return used == v.size();
+        } catch (...) {
+            return false;
+        }
+    };
+    auto add_counts_ranges = [&](const std::string& part, std::vector<uint8_t>& t) -> bool {
+        size_t start = 0;
+        while (start <= part.size()) {
+            size_t plus = part.find('+', start);
+            std::string piece = part.substr(
+                start, plus == std::string::npos ? std::string::npos : plus - start);
+            if (!piece.empty()) {
+                long lo, hi;
+                size_t dash = piece.find('-');
+                if (dash == std::string::npos) {
+                    if (!strict_long(piece, lo)) return false;
+                    hi = lo;
+                } else {
+                    if (!strict_long(piece.substr(0, dash), lo) ||
+                        !strict_long(piece.substr(dash + 1), hi))
+                        return false;
+                }
+                if (lo < 0 || hi >= (long)t.size() || lo > hi) return false;
+                for (long c = lo; c <= hi; ++c) t[(size_t)c] = 1;
+            }
+            if (plus == std::string::npos) break;
+            start = plus + 1;
+        }
+        return true;
+    };
+
+    if (!s.empty() && s[0] == 'b' && s.find("/s") != std::string::npos) {
+        out.radius = 1;
+        out.birth.assign(9, 0);
+        out.survive.assign(9, 0);
+        size_t cut = s.find("/s");
+        if (!add_counts_digits(s.substr(1, cut - 1), out.birth) ||
+            !add_counts_digits(s.substr(cut + 2), out.survive)) {
+            err = "rule '" + s + "': count out of range [0, 8] for radius 1";
+            return false;
+        }
+        return true;
+    }
+    if (!s.empty() && s[0] == 'r' && s.find(",b") != std::string::npos) {
+        size_t c1 = s.find(',');
+        size_t c2 = s.find(',', c1 + 1);
+        if (c2 == std::string::npos || s[c1 + 1] != 'b' || s[c2 + 1] != 's') {
+            err = "cannot parse rule string '" + s + "'";
+            return false;
+        }
+        long radius;
+        try {
+            radius = std::stol(s.substr(1, c1 - 1));
+        } catch (...) {
+            err = "cannot parse rule string '" + s + "'";
+            return false;
+        }
+        if (radius < 1 || radius > 7) {  // uint8 count accumulators (rules.py)
+            err = "radius must be in 1..7, got " + std::to_string(radius);
+            return false;
+        }
+        int side = 2 * (int)radius + 1;
+        size_t n = (size_t)(side * side);  // counts 0 .. (2r+1)^2 - 1
+        out.radius = (int)radius;
+        out.birth.assign(n, 0);
+        out.survive.assign(n, 0);
+        if (!add_counts_ranges(s.substr(c1 + 2, c2 - c1 - 2), out.birth) ||
+            !add_counts_ranges(s.substr(c2 + 2), out.survive)) {
+            err = "rule '" + s + "': count out of range [0, " +
+                  std::to_string(n - 1) + "] for radius " + std::to_string(radius);
+            return false;
+        }
+        return true;
+    }
+    err = "unknown rule '" + s +
+          "'; built-ins: bosco daynight highlife life seeds; or use "
+          "'B3/S23' / 'R5,B34-45,S33-57' syntax";
+    return false;
 }
 
 std::string timestamp_name() {
@@ -63,14 +170,28 @@ std::string timestamp_name() {
     return buf;
 }
 
-void write_tile(const std::string& dir, const std::string& name, int iter,
-                const uint8_t* grid, int64_t rows, int64_t cols) {
-    std::ofstream f(dir + "/" + name + "_" + std::to_string(iter) + "_0.gol");
-    f << 0 << " " << rows - 1 << "\n" << 0 << " " << cols - 1 << "\n";
-    for (int64_t i = 0; i < rows; ++i) {
-        for (int64_t j = 0; j < cols; ++j)
-            f << (grid[i * cols + j] ? "1" : "0") << "\t";
-        f << "\n";
+// One tile per worker with inclusive global coordinates, pid row-major in
+// the tile mesh — byte-identical to golio.write_tile (trailing tab per
+// row), and the same tiling the Python cpp-par path dumps.
+void write_tiles(const std::string& dir, const std::string& name, int iter,
+                 const uint8_t* grid, int64_t rows, int64_t cols,
+                 int ti, int tj) {
+    const int64_t tr = rows / ti, tc = cols / tj;
+    for (int i = 0; i < ti; ++i) {
+        for (int j = 0; j < tj; ++j) {
+            int pid = i * tj + j;
+            int64_t r0 = i * tr, c0 = j * tc;
+            std::ofstream f(dir + "/" + name + "_" + std::to_string(iter) +
+                            "_" + std::to_string(pid) + ".gol");
+            f << r0 << " " << r0 + tr - 1 << "\n"
+              << c0 << " " << c0 + tc - 1 << "\n";
+            for (int64_t k = 0; k < tr; ++k) {
+                const uint8_t* row = grid + (r0 + k) * cols + c0;
+                for (int64_t l = 0; l < tc; ++l)
+                    f << (row[l] ? "1" : "0") << "\t";
+                f << "\n";
+            }
+        }
     }
 }
 
@@ -78,7 +199,9 @@ void usage(const char* argv0) {
     std::fprintf(stderr,
         "usage: %s rows cols iteration_gap iterations [time_file] [first]\n"
         "       [--workers N] [--boundary periodic|dead] [--rule NAME]\n"
-        "       [--seed S] [--save] [--out-dir D] [--name N]\n",
+        "       [--seed S] [--save] [--out-dir D] [--name N]\n"
+        "rules: life|highlife|seeds|daynight|bosco, or B3/S23 /\n"
+        "       R5,B34-45,S33-57 syntax (radius 1..7)\n",
         argv0);
 }
 
@@ -148,9 +271,10 @@ int main(int argc, char** argv) {
         std::fprintf(stderr, "Illegal board size parameter combination!\n");
         return 2;
     }
-    const Rule* rule = find_rule(rule_name);
-    if (!rule) {
-        std::fprintf(stderr, "unknown rule '%s'\n", rule_name.c_str());
+    ParsedRule rule;
+    std::string rule_err;
+    if (!parse_rule(rule_name, rule, rule_err)) {
+        std::fprintf(stderr, "%s\n", rule_err.c_str());
         return 2;
     }
     if (boundary != "periodic" && boundary != "dead") {
@@ -167,8 +291,9 @@ int main(int argc, char** argv) {
     gol_init(grid.data(), rows, cols, seed, 0, 0);
 
     // worker-tile mesh: most-square factorization, shrinking the worker
-    // count until the mesh divides the grid (same policy as the Python
-    // bindings' plan_tiles); warn when degraded below the request.
+    // count until the mesh divides the grid into tiles that can source a
+    // radius-deep ghost slab (same policy as the Python bindings'
+    // plan_tiles); warn when degraded below the request.
     int requested = workers;
     int ti = 1, tj = 1;
     for (int w = workers; w >= 1; --w) {
@@ -176,8 +301,8 @@ int main(int argc, char** argv) {
         for (int a = 1; (int64_t)a * a <= w; ++a)
             if (w % a == 0) a_best = a;
         int b = w / a_best;
-        if (rows % a_best == 0 && cols % b == 0 && rows / a_best >= 1 &&
-            cols / b >= 1) {
+        if (rows % a_best == 0 && cols % b == 0 &&
+            rows / a_best >= rule.radius && cols / b >= rule.radius) {
             ti = a_best; tj = b;
             break;
         }
@@ -188,12 +313,13 @@ int main(int argc, char** argv) {
                      "(mesh must divide the grid)\n",
                      requested, ti, tj, ti * tj);
 
-    // master manifest (one writer process)
+    // master manifest (one writer process; processes = tile writers)
     {
         std::ofstream f(out_dir + "/" + name + ".gol");
-        f << rows << " " << cols << " " << gap << " " << iters << " " << 1 << "\n";
+        f << rows << " " << cols << " " << gap << " " << iters << " "
+          << ti * tj << "\n";
     }
-    if (save) write_tile(out_dir, name, 0, grid.data(), rows, cols);
+    if (save) write_tiles(out_dir, name, 0, grid.data(), rows, cols, ti, tj);
 
     auto t_setup = std::chrono::steady_clock::now();
 
@@ -202,18 +328,21 @@ int main(int argc, char** argv) {
         int64_t n = (save && gap > 0) ? std::min(gap, iters - done) : iters - done;
         int rc = 0;
         if (ti * tj > 1)
-            rc = gol_evolve_par(grid.data(), rows, cols, n, rule->birth,
-                                rule->survive, 1, periodic, ti, tj);
+            rc = gol_evolve_par(grid.data(), rows, cols, n, rule.birth.data(),
+                                rule.survive.data(), rule.radius, periodic,
+                                ti, tj);
         else
-            gol_evolve(grid.data(), rows, cols, n, rule->birth, rule->survive,
-                       1, periodic);
+            gol_evolve(grid.data(), rows, cols, n, rule.birth.data(),
+                       rule.survive.data(), rule.radius, periodic);
         if (rc != 0) {
             std::fprintf(stderr, "engine rejected %dx%d tile mesh (rc=%d)\n",
                          ti, tj, rc);
             return 1;
         }
         done += n;
-        if (save) write_tile(out_dir, name, (int)done, grid.data(), rows, cols);
+        if (save)
+            write_tiles(out_dir, name, (int)done, grid.data(), rows, cols,
+                        ti, tj);
     }
 
     auto t_end = std::chrono::steady_clock::now();

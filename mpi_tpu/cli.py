@@ -86,6 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="join a multi-host TPU slice via "
                    "jax.distributed.initialize() (launch one process per "
                    "host; the mpirun analog, reference gol.pbs)")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multihost coordinator address (default: "
+                   "auto-detect from the cluster environment)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="multihost process-group size (with --coordinator)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's rank in the group (with --coordinator)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR "
                    "(tpu backend; the framework's jax-native answer to the "
@@ -134,12 +141,24 @@ def _run(args) -> int:
             "sharded per host; assemble the tiles offline and restart "
             "single-host, or rerun from scratch"
         )
+    import os
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     if args.multihost:
         # must precede any other jax usage (the backend reads the process
         # group at initialization; the reference's MPI_Init analog)
         import jax
 
-        jax.distributed.initialize()
+        if args.coordinator:
+            jax.distributed.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+            )
+        else:
+            jax.distributed.initialize()
         _log(args.quiet,
              f"multihost: process {jax.process_index()}/{jax.process_count()}, "
              f"{jax.local_device_count()} local of {jax.device_count()} devices")
@@ -166,8 +185,6 @@ def _run(args) -> int:
         # load, no device init (jax.devices can hang on a dead tunnel);
         # the effective auto-chosen decomposition is re-checked below.
         config.validate_strict()
-
-    import os
 
     os.makedirs(args.out_dir, exist_ok=True)
     if args.name:
@@ -228,10 +245,20 @@ def _run(args) -> int:
         # an explicit --mesh (reference rules, main.cpp:194-200)
         config.validate_strict(effective_mesh)
 
-    golio.write_master(
-        args.out_dir, name, config.rows, config.cols,
-        args.iteration_gap, total_iter, processes,
-    )
+    def _is_report_writer() -> bool:
+        # multihost: process 0 is the reference's "rank 0" reporter —
+        # every host writing would double-append on a shared filesystem
+        if not args.multihost:
+            return True
+        import jax
+
+        return jax.process_index() == 0
+
+    if _is_report_writer():
+        golio.write_master(
+            args.out_dir, name, config.rows, config.cols,
+            args.iteration_gap, total_iter, processes,
+        )
     _log(args.quiet, f"run {name}: {config.rows}x{config.cols} x{config.steps} steps, "
          f"rule={rule}, boundary={config.boundary}, backend={config.backend}, "
          f"processes={processes}")
@@ -321,10 +348,19 @@ def _run(args) -> int:
         final = grid
 
     time_file = args.time_file or name
-    write_reports(
-        time_file, timer, config.rows, config.cols, processes,
-        first=bool(args.first), out_dir=args.out_dir,
-    )
+    all_durs = None
+    if args.multihost:
+        # collective: every process participates in the gather (the
+        # MPI_Reduce analog), even though only process 0 reports
+        from mpi_tpu.utils.timing import gather_process_durations
+
+        all_durs = gather_process_durations(timer)
+    if _is_report_writer():
+        write_reports(
+            time_file, timer, config.rows, config.cols, processes,
+            first=bool(args.first), out_dir=args.out_dir,
+            all_durations=all_durs,
+        )
     cps = timer.cells_per_sec(config.rows, config.cols, config.steps)
     _log(args.quiet,
          f"done: setup {timer.setup_us / 1e6:.2f}s, steady {timer.nosetup_us / 1e6:.2f}s, "

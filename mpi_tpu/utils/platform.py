@@ -1,0 +1,23 @@
+"""MPI_TPU_PLATFORM env hook — the working platform override.
+
+The ambient interpreter may pin ``jax_platforms`` at startup (a
+sitecustomize calling ``jax.config.update``), which the ``JAX_PLATFORMS``
+env var cannot beat; only another config update can.  Entry points (cli,
+bench) call :func:`apply_platform_override` before touching devices so
+``MPI_TPU_PLATFORM=cpu`` reliably forces the CPU backend — used to fake
+hosts with CPU processes (the reference's oversubscribed-mpirun trick,
+``/root/reference/run.sh:4-5``) and for degraded benchmarking when the
+TPU is unreachable.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> None:
+    plat = os.environ.get("MPI_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -68,6 +68,29 @@ class PhaseTimer:
         return rows * cols * iters / (ns / 1e6) if ns > 0 else 0.0
 
 
+def gather_process_durations(timer: PhaseTimer):
+    """Per-process ``[full, nosetup, setup]`` µs rows, allgathered across
+    the process group — the analog of the reference's three
+    ``MPI_Reduce(MPI_SUM)`` of per-rank durations to rank 0
+    (``/root/reference/main.cpp:319-324``), except every process gets the
+    table (allgather) so any of them could report.
+
+    Returns None in single-process runs: one host drives every device in
+    lockstep there, so per-process rows would all equal wall time anyway.
+    Collective — in a multi-process run every process must call it."""
+    import jax
+
+    if jax.process_count() == 1:
+        return None
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    durs = np.array(
+        [timer.full_us, timer.nosetup_us, timer.setup_us], dtype=np.int64
+    )
+    return np.asarray(multihost_utils.process_allgather(durs))
+
+
 def write_reports(
     time_file: str,
     timer: PhaseTimer,
@@ -76,23 +99,45 @@ def write_reports(
     processes: int,
     first: bool = False,
     out_dir: str = ".",
+    all_durations=None,
 ) -> None:
-    """Append the reference-schema pair of reports.  ``processes`` is the
-    device/worker count; per-process durations are taken equal to wall time
-    (single == avg; sum = wall × P), which matches how SPMD devices spend
-    time: all of them are driven for the whole run."""
-    full, nosetup, setup = timer.full_us, timer.nosetup_us, timer.setup_us
+    """Append the reference-schema pair of reports.
+
+    ``processes`` is the tile-writer count (devices/workers) reported in
+    the #P column.  ``all_durations`` — a (P_proc, 3) array of per-process
+    ``[full, nosetup, setup]`` µs from ``gather_process_durations`` — feeds
+    the avg/sum columns the way the reference's ``MPI_Reduce`` did (single
+    = process 0's time, the reference's rank 0).  Without it (single
+    process) per-device durations are taken equal to wall time (single ==
+    avg; sum = wall × P), which matches how SPMD devices spend time: all
+    of them are driven for the whole run."""
     p = max(processes, 1)
+    if all_durations is not None:
+        import numpy as np
+
+        a = np.asarray(all_durations, dtype=np.int64)
+        singles = a[0]
+        sums = a.sum(axis=0)
+        avgs = sums // a.shape[0]
+        triples = list(zip(singles.tolist(), avgs.tolist(), sums.tolist()))
+    else:
+        triples = [
+            (d, d, d * p)
+            for d in (timer.full_us, timer.nosetup_us, timer.setup_us)
+        ]
+    (full, full_a, full_s), (nos, nos_a, nos_s), (setup, setup_a, setup_s) = triples
     detailed = os.path.join(out_dir, f"{time_file}_detailed.out")
     with open(detailed, "a") as f:
         f.write("Timing results: microseconds\n")
         f.write(f"size:{rows} by {cols}\n")
         f.write(f"{p} Processors\n")
-        for label, single in (("Full (with setup)", full), ("Without setup", nosetup), ("Setup", setup)):
+        for label, (single, avg, total) in zip(
+            ("Full (with setup)", "Without setup", "Setup"), triples
+        ):
             f.write(f"{label}\n")
             f.write(f"Single time (rank 0): {single}us\n")
-            f.write(f"Avg single time: {single}us\n")
-            f.write(f"Summed time: {single * p}us\n")
+            f.write(f"Avg single time: {avg}us\n")
+            f.write(f"Summed time: {total}us\n")
         f.write(f"Throughput: {timer.cells_per_sec(rows, cols, 1):.0f} cells/sec/iter-unit\n")
         f.write("___________________________________________________\n\n")
     compact = os.path.join(out_dir, f"{time_file}_compact.csv")
@@ -100,6 +145,6 @@ def write_reports(
         if first:
             f.write(CSV_HEADER)
         f.write(
-            f"{rows},{cols},{p},{full},{full},{full * p},"
-            f"{nosetup},{nosetup},{nosetup * p},{setup},{setup},{setup * p}\n"
+            f"{rows},{cols},{p},{full},{full_a},{full_s},"
+            f"{nos},{nos_a},{nos_s},{setup},{setup_a},{setup_s}\n"
         )

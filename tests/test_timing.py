@@ -1,0 +1,43 @@
+"""Timing report schema + cross-process aggregation semantics
+(reference: 12-col CSV ``main.cpp:356-363``; 3x MPI_Reduce ``319-324``)."""
+
+import numpy as np
+
+from mpi_tpu.utils.timing import CSV_HEADER, PhaseTimer, write_reports
+
+
+def _timer(full, setup):
+    t = PhaseTimer(t_begin=0.0)
+    t.t_setup_done = setup / 1e6
+    t.t_end = full / 1e6
+    return t
+
+
+def test_write_reports_single_process_fabrication(tmp_path):
+    # one process driving P devices in lockstep: single == avg, sum = wall*P
+    write_reports("t", _timer(1000, 400), 8, 8, processes=4,
+                  first=True, out_dir=str(tmp_path))
+    header, row = (tmp_path / "t_compact.csv").read_text().strip().split("\n")
+    assert header + "\n" == CSV_HEADER
+    v = [int(x) for x in row.split(",")]
+    assert v == [8, 8, 4, 1000, 1000, 4000, 600, 600, 2400, 400, 400, 1600]
+
+
+def test_write_reports_aggregated_durations(tmp_path):
+    # multihost: avg/sum come from the gathered per-process rows (the
+    # MPI_Reduce analog), single is process 0's — NOT wall*P fabrication
+    all_durs = np.array([[1000, 600, 400],    # process 0: full,nosetup,setup
+                         [1400, 900, 500]])   # process 1
+    write_reports("m", _timer(1000, 400), 8, 8, processes=4,
+                  first=True, out_dir=str(tmp_path),
+                  all_durations=all_durs)
+    row = (tmp_path / "m_compact.csv").read_text().strip().split("\n")[1]
+    v = [int(x) for x in row.split(",")]
+    assert v == [8, 8, 4,
+                 1000, 1200, 2400,   # full: single=p0, avg=mean, sum
+                 600, 750, 1500,     # nosetup
+                 400, 450, 900]      # setup
+    detailed = (tmp_path / "m_detailed.out").read_text()
+    assert "Single time (rank 0): 1000us" in detailed
+    assert "Avg single time: 1200us" in detailed
+    assert "Summed time: 2400us" in detailed

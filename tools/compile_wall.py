@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Mosaic compile-time wall experiment (VERDICT r1 item 8).
+
+Sub-tiled packed kernels at NW > 512 hit pathological Mosaic compile
+times (a (BM=256, CM=64) kernel at NW=2048 did not finish compiling in
+9 minutes), so ``_pick_blocks`` currently disables sub-tiling wholesale
+for wide rows.  This tool produces the measurement that decision should
+rest on: a (BM, CM) × NW × gens table of
+
+  * compile seconds (or TIMEOUT),
+  * steady-state Gcell/s for the configs that do compile,
+
+so the next perf push can either enable faster wide configs in
+``_pick_blocks`` or keep single-tile with numbers to point at.
+
+Each config compiles in its own subprocess with a hard timeout — a
+Mosaic hang must cost one config, not the run.  Needs a real TPU; a
+non-TPU platform fails fast per config.
+
+    python tools/compile_wall.py --h 16384 --w 65536 --gens 1 8 \
+        --timeout 240 --out perf/compile_wall.json
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BLOCK_SIZES = (512, 256, 128, 64)
+
+
+def child(h: int, nw: int, bm: int, cm: int, gens: int, steps: int) -> None:
+    import jax
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    import jax.numpy as jnp
+    from jax import lax
+    import numpy as np
+
+    from mpi_tpu.models.rules import LIFE
+    from mpi_tpu.ops.bitlife import init_packed
+    from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        raise RuntimeError(f"compile-wall experiment needs a TPU, got {platform!r}")
+
+    @jax.jit
+    def one(p):
+        out, _ = lax.scan(
+            lambda x, _: (
+                pallas_bit_step(x, LIFE, "periodic", gens=gens, blocks=(bm, cm)),
+                None,
+            ),
+            p, None, length=max(1, steps // gens),
+        )
+        return jnp.sum(lax.population_count(out).astype(jnp.uint32))
+
+    grid = init_packed(h, nw * 32, seed=1)
+    t0 = time.perf_counter()
+    compiled = one.lower(grid).compile()
+    compile_s = time.perf_counter() - t0
+
+    int(np.asarray(compiled(grid)))  # warm
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(np.asarray(compiled(grid)))
+        dt = time.perf_counter() - t0
+        eff_steps = max(1, steps // gens) * gens
+        best = max(best, h * nw * 32 * eff_steps / dt)
+    print(json.dumps({"compile_s": round(compile_s, 2),
+                      "gcells_per_s": round(best / 1e9, 1)}))
+
+
+def probe() -> None:
+    import jax
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    print(json.dumps({"platform": jax.devices()[0].platform}))
+
+
+def _write_out(path: str, results) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--h", type=int, default=16384, help="grid rows")
+    p.add_argument("--w", type=int, default=65536, help="grid cols (cells)")
+    p.add_argument("--gens", type=int, nargs="+", default=[1, 8])
+    p.add_argument("--steps", type=int, default=48)
+    p.add_argument("--timeout", type=float, default=240.0,
+                   help="per-config compile+bench budget (seconds)")
+    p.add_argument("--out", default="perf/compile_wall.json")
+    args = p.parse_args(argv)
+
+    # Upfront reachability probe: a dead tunnel hangs jax.devices() before
+    # the child ever reaches its platform check, and a config that times
+    # out on a hung device probe must not be recorded as a Mosaic compile
+    # wall — that is the exact confusion this tool exists to resolve.
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True, text=True, timeout=150,
+        )
+        platform = json.loads(proc.stdout.strip().splitlines()[-1])["platform"]
+    except (subprocess.TimeoutExpired, IndexError, KeyError,
+            json.JSONDecodeError):
+        platform = None
+    if platform != "tpu":
+        print(f"error: TPU unreachable (probe platform={platform!r}); "
+              "refusing to record device hangs as compile walls",
+              file=sys.stderr)
+        return 1
+
+    nw = args.w // 32
+    results = []
+    for gens in args.gens:
+        halo = 8 if gens <= 8 else 16
+        for bm in BLOCK_SIZES:
+            if args.h % bm or bm % halo:
+                continue
+            for cm in (None, *BLOCK_SIZES):
+                # None = single-tile window (CM >= BM + 2(gens-1), the
+                # current wide-row policy); else an explicit sub-tile
+                eff_cm = bm + 2 * halo if cm is None else cm
+                if cm is not None and cm > bm:
+                    continue
+                tag = dict(nw=nw, gens=gens, bm=bm,
+                           cm="single" if cm is None else cm)
+                t0 = time.perf_counter()
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__), "--child",
+                         str(args.h), str(nw), str(bm), str(eff_cm),
+                         str(gens), str(args.steps)],
+                        capture_output=True, text=True, timeout=args.timeout,
+                    )
+                    if proc.returncode == 0:
+                        try:
+                            tag.update(json.loads(
+                                proc.stdout.strip().splitlines()[-1]))
+                        except (IndexError, json.JSONDecodeError):
+                            tag["error"] = (
+                                f"unparseable child output: {proc.stdout[-200:]!r}")
+                    else:
+                        err = (proc.stderr or "").strip().splitlines()
+                        tag["error"] = err[-1][:200] if err else f"rc={proc.returncode}"
+                except subprocess.TimeoutExpired:
+                    tag["error"] = f"TIMEOUT>{args.timeout:.0f}s"
+                tag["wall_s"] = round(time.perf_counter() - t0, 1)
+                results.append(tag)
+                print(json.dumps(tag), flush=True)
+                # incremental: a crash or ^C hours in must not lose the
+                # configs already measured (each costs up to --timeout)
+                _write_out(args.out, results)
+    print(f"wrote {args.out} ({len(results)} configs)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(*(int(x) for x in sys.argv[2:8]))
+    else:
+        sys.exit(main())

@@ -9,6 +9,12 @@ Usage:
     python tools/gol_visualization.py RUN.gol                 # RUN.gif
     python tools/gol_visualization.py RUN.gol --format png    # RUN_<it>.png
     python tools/gol_visualization.py RUN.gol --format ascii  # stdout
+    python tools/gol_visualization.py RUN.gol --format live   # on-screen
+
+``--format live`` is the reference's interactive mode
+(/root/reference/gol_visualization.py:36-39, plt.pcolor + 0.5 s pause)
+for machines with a display; it needs a GUI matplotlib backend and falls
+back with an error pointing at gif/png/ascii when none is available.
 """
 
 from __future__ import annotations
@@ -27,7 +33,7 @@ from mpi_tpu import golio  # noqa: E402
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("master", help="path to the master .gol file")
-    p.add_argument("--format", choices=["gif", "png", "ascii"], default="gif")
+    p.add_argument("--format", choices=["gif", "png", "ascii", "live"], default="gif")
     p.add_argument("--out", default=None, help="output path (gif) or dir (png)")
     p.add_argument("--fps", type=float, default=2.0)
     p.add_argument("--max-frames", type=int, default=200)
@@ -53,6 +59,42 @@ def main(argv=None) -> int:
         return 0
 
     import matplotlib
+
+    if args.format == "live":
+        # interactive window, frame per snapshot — the reference
+        # visualizer's behavior (0.5 s pause ≙ fps 2 default)
+        import matplotlib.pyplot as plt
+
+        noninteractive = {"agg", "pdf", "svg", "ps", "cairo", "template", "pgf"}
+        headless_msg = (
+            "no usable GUI matplotlib backend (headless session?); "
+            "use --format gif/png/ascii instead"
+        )
+        if matplotlib.get_backend().lower() in noninteractive:
+            print(headless_msg, file=sys.stderr)
+            return 1
+        try:
+            # a GUI backend can be configured yet unusable (e.g. QtAgg
+            # without a display) — it fails here, not at the string check
+            fig, ax = plt.subplots(figsize=(6, 6 * rows / cols))
+            ax.set_axis_off()
+            im = ax.imshow(
+                golio.assemble(out_dir, name, saved[0]),
+                cmap="binary", interpolation="nearest", vmin=0, vmax=1,
+            )
+            plt.ion()
+            plt.show()
+        except Exception as e:  # noqa: BLE001 - GUI init errors vary by toolkit
+            print(f"{headless_msg} ({type(e).__name__}: {e})", file=sys.stderr)
+            return 1
+        for it in saved:
+            im.set_data(golio.assemble(out_dir, name, it))
+            ax.set_title(f"Iteration={it}")
+            fig.canvas.draw_idle()
+            plt.pause(1.0 / args.fps)
+        plt.ioff()
+        plt.show()
+        return 0
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
@@ -97,4 +139,9 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed stdout — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

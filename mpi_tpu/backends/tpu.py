@@ -116,12 +116,45 @@ def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int):
     )
 
 
+def _put_initial(mesh, initial, rows: int, cols: int, packed: bool):
+    """Place a checkpoint grid onto the mesh sharding.
+
+    ``initial`` is either a host-global (rows, cols) uint8 array or a
+    region loader ``f(r0, r1, c0, c1) -> uint8 array`` (multihost resume:
+    no host can hold — or even read — the whole grid, so each host loads
+    exactly its addressable shards and the global array is assembled with
+    ``jax.make_array_from_single_device_arrays``)."""
+    from mpi_tpu.ops.bitlife import WORD, pack_np
+    from mpi_tpu.parallel.step import grid_sharding
+
+    if callable(initial):
+        loader = initial
+    else:
+        arr = np.asarray(initial, dtype=np.uint8)
+
+        def loader(r0, r1, c0, c1):
+            return arr[r0:r1, c0:c1]
+
+    sharding = grid_sharding(mesh)
+    gshape = (rows, cols // WORD) if packed else (rows, cols)
+    arrays = []
+    for dev, idx in sharding.addressable_devices_indices_map(gshape).items():
+        r0, r1 = idx[0].start or 0, idx[0].stop or gshape[0]
+        c0, c1 = idx[1].start or 0, idx[1].stop or gshape[1]
+        if packed:
+            tile = pack_np(loader(r0, r1, c0 * WORD, c1 * WORD))
+        else:
+            tile = np.asarray(loader(r0, r1, c0, c1), dtype=np.uint8)
+        arrays.append(jax.device_put(tile, dev))
+    return jax.make_array_from_single_device_arrays(gshape, sharding, arrays)
+
+
 def run_tpu(
     config: GolConfig,
     timer: Optional[PhaseTimer] = None,
     snapshot_cb: Optional[SnapshotCb] = None,
     mesh=None,
-    initial: Optional[np.ndarray] = None,
+    initial=None,
     start_iteration: int = 0,
 ):
     """Run one configuration; returns the final grid as a host numpy array
@@ -129,7 +162,8 @@ def run_tpu(
     the global array — the snapshot tiles are the multi-host output).
 
     initial/start_iteration support checkpoint-restart: pass a grid loaded
-    by ``golio.load_snapshot`` and the iteration it was saved at.
+    by ``golio.load_snapshot`` (or, multihost, a region loader backed by
+    ``golio.assemble_region``) and the iteration it was saved at.
     """
     timer = timer or PhaseTimer()
     mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
@@ -176,13 +210,13 @@ def run_tpu(
 
         evolve = _pick_packed_evolve(config, mesh, mi * mj)
         if initial is not None:
-            grid = jax.device_put(pack_np(initial), grid_sharding(mesh))
+            grid = _put_initial(mesh, initial, config.rows, config.cols, True)
         else:
             grid = sharded_bit_init(mesh, config.rows, config.cols, config.seed)
     else:
         evolve = _pick_dense_evolve(config, mesh, mi * mj)
         if initial is not None:
-            grid = jax.device_put(np.asarray(initial, dtype=np.uint8), grid_sharding(mesh))
+            grid = _put_initial(mesh, initial, config.rows, config.cols, False)
         else:
             grid = sharded_init(mesh, config.rows, config.cols, config.seed)
 

@@ -130,19 +130,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _run(args) -> int:
-    if args.resume and args.multihost:
-        # Multihost snapshots are sharded per host (each host's disk holds
-        # only its addressable shards), so no host can assemble the full
-        # grid, and device_put of a host-global array onto a sharding
-        # spanning non-addressable devices is invalid anyway.  Check
-        # before jax.distributed.initialize() so the error is immediate.
-        raise ConfigError(
-            "--resume is not supported with --multihost: snapshots are "
-            "sharded per host; assemble the tiles offline and restart "
-            "single-host, or rerun from scratch"
-        )
     import os
 
+    if args.multihost and args.backend != "tpu":
+        # the process group is the TPU slice; the other backends are
+        # single-process by construction
+        raise ConfigError(
+            f"--multihost applies to the tpu backend only "
+            f"(got backend={args.backend!r})"
+        )
     from mpi_tpu.utils.platform import apply_platform_override
 
     apply_platform_override()
@@ -206,14 +202,27 @@ def _run(args) -> int:
         except ValueError:
             raise ConfigError(f"--resume must look like NAME@ITER, got {args.resume!r}")
         try:
-            initial = golio.load_snapshot(args.out_dir, rname, start_iter)
+            srows, scols, _, _, _ = golio.read_master(
+                golio.master_path(args.out_dir, rname))
         except FileNotFoundError as e:
             raise ConfigError(f"cannot resume {args.resume!r}: {e}")
-        if initial.shape != (config.rows, config.cols):
+        if (srows, scols) != (config.rows, config.cols):
             raise ConfigError(
-                f"snapshot {rname}@{start_iter} is {initial.shape}, "
+                f"snapshot {rname}@{start_iter} is {(srows, scols)}, "
                 f"run asks for {(config.rows, config.cols)}"
             )
+        if args.multihost:
+            # no host materializes (or even reads) the global grid: the
+            # runner calls this per addressable shard, each host touching
+            # only the tile files that intersect its shards
+            def initial(r0, r1, c0, c1, _rn=rname, _it=start_iter):
+                return golio.assemble_region(
+                    args.out_dir, _rn, _it, r0, r1, c0, c1)
+        else:
+            try:
+                initial = golio.load_snapshot(args.out_dir, rname, start_iter)
+            except FileNotFoundError as e:
+                raise ConfigError(f"cannot resume {args.resume!r}: {e}")
         name = args.name or rname
         _log(args.quiet, f"resumed {rname}@{start_iter}")
 
@@ -254,11 +263,14 @@ def _run(args) -> int:
 
         return jax.process_index() == 0
 
-    if _is_report_writer():
-        golio.write_master(
-            args.out_dir, name, config.rows, config.cols,
-            args.iteration_gap, total_iter, processes,
-        )
+    # every host writes the master manifest: the content is identical and
+    # the write idempotent ("w" mode), and per-host-disk deployments need
+    # it locally for resume's read_master — only the append-mode timing
+    # reports must stay single-writer
+    golio.write_master(
+        args.out_dir, name, config.rows, config.cols,
+        args.iteration_gap, total_iter, processes,
+    )
     _log(args.quiet, f"run {name}: {config.rows}x{config.cols} x{config.steps} steps, "
          f"rule={rule}, boundary={config.boundary}, backend={config.backend}, "
          f"processes={processes}")

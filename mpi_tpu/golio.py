@@ -71,6 +71,15 @@ def write_tile(
     return path
 
 
+def read_tile_header(path: str) -> Tuple[int, int, int, int]:
+    """Just the (firstRow, lastRow, firstCol, lastCol) metadata — lets
+    callers test intersection without parsing the tile body."""
+    with open(path) as f:
+        r0, r1 = map(int, f.readline().split())
+        c0, c1 = map(int, f.readline().split())
+    return r0, r1, c0, c1
+
+
 def read_tile(path: str) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
     with open(path) as f:
         r0, r1 = map(int, f.readline().split())
@@ -135,6 +144,41 @@ def assemble(out_dir: str, name: str, iteration: int) -> np.ndarray:
 def load_snapshot(out_dir: str, name: str, iteration: int) -> np.ndarray:
     """Checkpoint-restart entry: the global grid at a saved iteration."""
     return assemble(out_dir, name, iteration)
+
+
+def assemble_region(
+    out_dir: str, name: str, iteration: int,
+    r0: int, r1: int, c0: int, c1: int,
+) -> np.ndarray:
+    """Assemble one sub-rectangle (inclusive-exclusive rows [r0, r1), cols
+    [c0, c1)) of a saved iteration, reading only the tile files that
+    intersect it — the multihost resume path: each host loads exactly its
+    addressable shards without ever materializing the global grid."""
+    pids = iteration_tile_pids(out_dir, name, iteration)
+    if not pids:
+        raise ValueError(f"snapshot {name}@{iteration}: no tile files found")
+    region = np.zeros((r1 - r0, c1 - c0), dtype=np.uint8)
+    seen = np.zeros(region.shape, dtype=bool)
+    for pid in pids:
+        path = tile_path(out_dir, name, iteration, pid)
+        # header first: skip the (potentially huge) tab-separated body of
+        # tiles that don't intersect the requested region
+        tr0, tr1, tc0, tc1 = read_tile_header(path)
+        ir0, ir1 = max(r0, tr0), min(r1, tr1 + 1)
+        ic0, ic1 = max(c0, tc0), min(c1, tc1 + 1)
+        if ir0 >= ir1 or ic0 >= ic1:
+            continue
+        tile, _ = read_tile(path)
+        region[ir0 - r0 : ir1 - r0, ic0 - c0 : ic1 - c0] = tile[
+            ir0 - tr0 : ir1 - tr0, ic0 - tc0 : ic1 - tc0]
+        seen[ir0 - r0 : ir1 - r0, ic0 - c0 : ic1 - c0] = True
+    if not seen.all():
+        raise ValueError(
+            f"snapshot {name}@{iteration}: tiles cover only "
+            f"{int(seen.sum())}/{seen.size} cells of region "
+            f"[{r0}:{r1}, {c0}:{c1}]"
+        )
+    return region
 
 
 def remove_stale_tiles(out_dir: str, name: str, iteration: int, keep_pids) -> None:

@@ -195,14 +195,14 @@ def test_visualizer_ascii_and_gif(tmp_path, capsys):
     assert os.path.getsize(gif) > 0
 
 
-def test_cli_resume_multihost_rejected(tmp_path, capsys):
+def test_cli_resume_missing_snapshot_rejected(tmp_path, capsys):
     rc = main([
         "32", "32", "8", "16", "--backend", "tpu",
-        "--out-dir", str(tmp_path), "--resume", "x@8", "--multihost",
+        "--out-dir", str(tmp_path), "--resume", "x@8",
         "--quiet",
     ])
     assert rc == 2
-    assert "multihost" in capsys.readouterr().err
+    assert "cannot resume" in capsys.readouterr().err
 
 
 def test_cli_rerun_fewer_writers_prunes_stale_tiles(tmp_path):

@@ -30,7 +30,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(pid: int, port: int, out_dir: str) -> subprocess.Popen:
+def _launch(pid: int, port: int, out_dir: str, argv=None) -> subprocess.Popen:
     env = dict(os.environ)
     # two virtual CPU devices per process → a 4-device global mesh; the
     # MPI_TPU_PLATFORM hook beats the ambient sitecustomize platform pin
@@ -38,9 +38,9 @@ def _launch(pid: int, port: int, out_dir: str) -> subprocess.Popen:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env["PYTHONPATH"] = REPO
+    argv = argv if argv is not None else ["32", "32", "8", "16", "mh", "1"]
     return subprocess.Popen(
-        [sys.executable, "-m", "mpi_tpu.cli",
-         "32", "32", "8", "16", "mh", "1",
+        [sys.executable, "-m", "mpi_tpu.cli", *argv,
          "--backend", "tpu", "--save", "--multihost",
          "--coordinator", f"localhost:{port}",
          "--num-processes", "2", "--process-id", str(pid),
@@ -48,6 +48,23 @@ def _launch(pid: int, port: int, out_dir: str) -> subprocess.Popen:
         env=env, cwd=REPO,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
+
+
+def _run_group(out_dir: str, argv=None) -> None:
+    port = _free_port()
+    procs = [_launch(pid, port, out_dir, argv) for pid in (0, 1)]
+    outs = []
+    # collect everything before asserting: an early assert would leak the
+    # other process (blocked on the dead coordinator) into the session
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=300))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"multihost process failed:\n{out}\n{err[-2000:]}"
 
 
 def test_two_process_multihost_run(tmp_path):
@@ -90,3 +107,25 @@ def test_two_process_multihost_run(tmp_path):
     assert full_avg == full_sum // 2  # mean over the two process rows
     nos_single, nos_avg, nos_sum = row[6:9]
     assert nos_sum >= nos_single > 0 and nos_avg == nos_sum // 2
+
+
+def test_two_process_multihost_packed_engine(tmp_path):
+    # word-aligned shard widths (256/2 = 128 % 32 == 0) route the
+    # multihost run through the bitpacked SWAR stepper
+    _run_group(str(tmp_path), ["64", "256", "16", "16"])
+    name = "run-64x256-16-s5"
+    final = golio.assemble(str(tmp_path), name, 16)
+    ref = evolve_np(init_tile_np(64, 256, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_two_process_multihost_resume(tmp_path):
+    # checkpoint-resume across a process group: each host loads only the
+    # snapshot regions of its addressable shards (golio.assemble_region +
+    # make_array_from_single_device_arrays), no host-global grid
+    _run_group(str(tmp_path), ["32", "32", "8", "8", "--name", "ckpt"])
+    _run_group(str(tmp_path), ["32", "32", "8", "8", "--name", "ckpt",
+                               "--resume", "ckpt@8"])
+    final = golio.assemble(str(tmp_path), "ckpt", 16)
+    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)

@@ -73,14 +73,14 @@ def main(argv=None) -> int:
         if matplotlib.get_backend().lower() in noninteractive:
             print(headless_msg, file=sys.stderr)
             return 1
+        first = golio.assemble(out_dir, name, saved[0])  # data errors stay data errors
         try:
             # a GUI backend can be configured yet unusable (e.g. QtAgg
             # without a display) — it fails here, not at the string check
             fig, ax = plt.subplots(figsize=(6, 6 * rows / cols))
             ax.set_axis_off()
             im = ax.imshow(
-                golio.assemble(out_dir, name, saved[0]),
-                cmap="binary", interpolation="nearest", vmin=0, vmax=1,
+                first, cmap="binary", interpolation="nearest", vmin=0, vmax=1,
             )
             plt.ion()
             plt.show()

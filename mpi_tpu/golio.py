@@ -42,8 +42,14 @@ def write_master(
     """The manifest the visualizer reads (reference ``setUpProgram``,
     ``main_serial.cpp:97-113``)."""
     path = master_path(out_dir, name)
-    with open(path, "w") as f:
+    # atomic replace: under multihost every process writes the manifest
+    # (per-host disks need it locally) while a lagging process may still
+    # be read_master-ing it for resume — readers must never see a
+    # truncated/torn file
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         f.write(f"{rows} {cols} {iteration_gap} {iterations} {processes}\n")
+    os.replace(tmp, path)
     return path
 
 
@@ -124,21 +130,7 @@ def assemble(out_dir: str, name: str, iteration: int) -> np.ndarray:
     master header can only record one value.
     """
     rows, cols, _, _, _ = read_master(master_path(out_dir, name))
-    pids = iteration_tile_pids(out_dir, name, iteration)
-    if not pids:
-        raise ValueError(f"snapshot {name}@{iteration}: no tile files found")
-    grid = np.zeros((rows, cols), dtype=np.uint8)
-    seen = np.zeros((rows, cols), dtype=bool)
-    for pid in pids:
-        tile, (r0, r1, c0, c1) = read_tile(tile_path(out_dir, name, iteration, pid))
-        grid[r0 : r1 + 1, c0 : c1 + 1] = tile
-        seen[r0 : r1 + 1, c0 : c1 + 1] = True
-    if not seen.all():
-        raise ValueError(
-            f"snapshot {name}@{iteration}: tiles cover only "
-            f"{int(seen.sum())}/{rows * cols} cells"
-        )
-    return grid
+    return assemble_region(out_dir, name, iteration, 0, rows, 0, cols)
 
 
 def load_snapshot(out_dir: str, name: str, iteration: int) -> np.ndarray:

@@ -23,6 +23,7 @@
 // Exposed via a C ABI for the ctypes wrapper in backends/cpp.py.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <condition_variable>
 #include <mutex>
@@ -92,6 +93,31 @@ void step_padded(const uint8_t* in, uint8_t* out, int64_t rows, int64_t cols,
         }
     }
 }
+
+// ---------------------------------------------------------------------------
+// Reusable spinning-free barrier (C++17; std::barrier is C++20).
+// ---------------------------------------------------------------------------
+
+class Barrier {
+  public:
+    explicit Barrier(int n) : n_(n), waiting_(0), phase_(0) {}
+    void arrive_and_wait() {
+        std::unique_lock<std::mutex> lk(m_);
+        int phase = phase_;
+        if (++waiting_ == n_) {
+            waiting_ = 0;
+            ++phase_;
+            cv_.notify_all();
+        } else {
+            cv_.wait(lk, [&] { return phase_ != phase; });
+        }
+    }
+
+  private:
+    int n_, waiting_, phase_;
+    std::mutex m_;
+    std::condition_variable cv_;
+};
 
 // ---------------------------------------------------------------------------
 // Bitpacked SWAR engine (radius-1 rules, cols % 64 == 0) — the native
@@ -181,11 +207,13 @@ void swar_fill_ghost_rows(uint64_t* buf, int64_t rows, int64_t nw, bool periodic
     }
 }
 
-void swar_pack(const uint8_t* grid, uint64_t* buf, int64_t rows, int64_t cols) {
+// ghost = leading ghost rows in buf (1 for the padded layout, 0 interior-only)
+void swar_pack(const uint8_t* grid, uint64_t* buf, int64_t rows, int64_t cols,
+               int ghost) {
     const int64_t nw = cols / 64;
     for (int64_t i = 0; i < rows; ++i) {
         const uint8_t* row = grid + i * cols;
-        uint64_t* prow = buf + (i + 1) * nw;
+        uint64_t* prow = buf + (i + ghost) * nw;
         for (int64_t j = 0; j < nw; ++j) {
             uint64_t w = 0;
             for (int b = 0; b < 64; ++b)
@@ -195,11 +223,12 @@ void swar_pack(const uint8_t* grid, uint64_t* buf, int64_t rows, int64_t cols) {
     }
 }
 
-void swar_unpack(const uint64_t* buf, uint8_t* grid, int64_t rows, int64_t cols) {
+void swar_unpack(const uint64_t* buf, uint8_t* grid, int64_t rows, int64_t cols,
+                 int ghost) {
     const int64_t nw = cols / 64;
     for (int64_t i = 0; i < rows; ++i) {
         uint8_t* row = grid + i * cols;
-        const uint64_t* prow = buf + (i + 1) * nw;
+        const uint64_t* prow = buf + (i + ghost) * nw;
         for (int64_t j = 0; j < nw; ++j)
             for (int b = 0; b < 64; ++b)
                 row[j * 64 + b] = (prow[j] >> b) & 1u;
@@ -208,6 +237,150 @@ void swar_unpack(const uint64_t* buf, uint8_t* grid, int64_t rows, int64_t cols)
 
 bool swar_eligible(int64_t cols, int radius) {
     return radius == 1 && cols % 64 == 0 && cols > 0;
+}
+
+// ---------------------------------------------------------------------------
+// Temporal blocking for DRAM-resident grids — the CPU mirror of the Pallas
+// kernel's gens-deep VMEM blocking (ops/pallas_bitlife.py): each sweep
+// advances independent row blocks G generations inside a cache-resident
+// slab (block rows + 2G halo rows + 1 ghost row per side), touching DRAM
+// once per G generations instead of once per generation.  Neighboring
+// blocks recompute each other's halo rows redundantly from the same
+// source sweep (overlapped/trapezoidal tiling), so blocks — and threads —
+// stay independent between barriers.
+// ---------------------------------------------------------------------------
+
+struct SwarSlab {
+    std::vector<uint64_t> a, b;
+    SwarScratch scratch;
+    SwarSlab(int64_t max_slab_rows, int64_t nw)
+        : a((size_t)(max_slab_rows * nw)),
+          b((size_t)(max_slab_rows * nw)),
+          scratch(nw) {}
+};
+
+// Packed-grid bytes above which the temporally-blocked sweeps kick in.
+// Default: disabled — measured on this machine (1 core, 16384², 16
+// steps) the plain per-generation sweep is compute-bound at ~0.7 GB/s of
+// traffic, and blocking's slab copies + redundant halo rows cost more
+// than the cache locality earns (2.85 → 2.40 Gcell/s).  The machinery
+// stays available (GOLCORE_SWAR_BLOCK_THRESHOLD=bytes) for hosts where
+// many cores share DRAM bandwidth and the plain sweep *is* memory-bound;
+// tests force 0 to pin its correctness.
+int64_t swar_block_threshold() {
+    const char* e = std::getenv("GOLCORE_SWAR_BLOCK_THRESHOLD");
+    return e ? std::atoll(e) : INT64_MAX;
+}
+
+// Pick the block height so one slab buffer stays cache-resident.
+int64_t swar_pick_block_rows(int64_t nw, int64_t G) {
+    const int64_t budget = 768 << 10;  // bytes per slab buffer (~L2-sized)
+    int64_t S = budget / (nw * 8);
+    int64_t B = S - 2 * G - 2;
+    if (B < 32) return 0;  // rows too wide to block profitably
+    if (B > 512) B = 512;
+    return B;
+}
+
+// One G-generation sweep over blocks [blk0, blk1) of height B: reads the
+// full src grid (interior-only, rows x nw), writes those blocks' rows of
+// dst stepped G generations.
+void swar_blocked_sweep(const uint64_t* src, uint64_t* dst, int64_t rows,
+                        int64_t nw, bool periodic, const uint8_t* birth,
+                        const uint8_t* survive, int64_t G, int64_t B,
+                        int64_t blk0, int64_t blk1, SwarSlab& slab) {
+    for (int64_t blk = blk0; blk < blk1; ++blk) {
+        const int64_t base = blk * B;
+        const int64_t Beff = std::min(B, rows - base);
+        const int64_t S = Beff + 2 * G + 2;  // slab rows incl. ghosts
+        uint64_t* cur = slab.a.data();
+        uint64_t* nxt = slab.b.data();
+        // slab row s holds grid row base - G - 1 + s (wrapped / zeroed)
+        for (int64_t s = 0; s < S; ++s) {
+            int64_t r = base - G - 1 + s;
+            if (periodic) {
+                r = ((r % rows) + rows) % rows;
+                std::memcpy(cur + s * nw, src + r * nw, (size_t)nw * 8);
+            } else if (r < 0 || r >= rows) {
+                std::memset(cur + s * nw, 0, (size_t)nw * 8);
+            } else {
+                std::memcpy(cur + s * nw, src + r * nw, (size_t)nw * 8);
+            }
+        }
+        for (int64_t g = 0; g < G; ++g) {
+            // validity shrinks one row per side per generation
+            swar_gen_rows(cur, nxt, nw, 1 + g, S - 1 - g, periodic, birth,
+                          survive, slab.scratch);
+            if (!periodic) {
+                // slab rows outside the grid are not real cells; live grid
+                // neighbors "give birth" into them — re-kill after every
+                // in-slab generation (same discipline as the Pallas
+                // kernel's edge blocks and the overlap steppers)
+                const int64_t lead = std::max<int64_t>(0, G + 1 - base);
+                const int64_t tail =
+                    std::max<int64_t>(0, (base + Beff + G + 1) - rows);
+                for (int64_t s = 1 + g; s < std::min(lead, S - 1 - g); ++s)
+                    std::memset(nxt + s * nw, 0, (size_t)nw * 8);
+                for (int64_t s = std::max(S - tail, 1 + g); s < S - 1 - g; ++s)
+                    std::memset(nxt + s * nw, 0, (size_t)nw * 8);
+            }
+            std::swap(cur, nxt);
+        }
+        std::memcpy(dst + base * nw, cur + (1 + G) * nw,
+                    (size_t)(Beff * nw) * 8);
+    }
+}
+
+// Evolve an interior-only packed grid `steps` generations with temporal
+// blocking, `threads_n` workers owning disjoint block ranges per sweep.
+void swar_evolve_blocked(uint64_t* grid0, uint64_t* grid1, int64_t rows,
+                         int64_t nw, bool periodic, const uint8_t* birth,
+                         const uint8_t* survive, int64_t steps, int64_t B,
+                         int64_t G, int threads_n) {
+    const int64_t nblocks = (rows + B - 1) / B;
+    if (threads_n > nblocks) threads_n = (int)nblocks;
+    if (threads_n < 1) threads_n = 1;
+    uint64_t* bufs[2] = {grid0, grid1};
+    if (threads_n == 1) {
+        SwarSlab slab(B + 2 * G + 2, nw);
+        int cur = 0;
+        int64_t done = 0;
+        while (done < steps) {
+            const int64_t g = std::min(G, steps - done);
+            swar_blocked_sweep(bufs[cur], bufs[1 - cur], rows, nw, periodic,
+                               birth, survive, g, B, 0, nblocks, slab);
+            cur = 1 - cur;
+            done += g;
+        }
+        if (cur == 1)
+            std::memcpy(grid0, grid1, (size_t)(rows * nw) * 8);
+        return;
+    }
+    Barrier barrier(threads_n);
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)threads_n);
+    for (int t = 0; t < threads_n; ++t) {
+        const int64_t b0 = nblocks * t / threads_n;
+        const int64_t b1 = nblocks * (t + 1) / threads_n;
+        threads.emplace_back([=, &barrier]() {
+            SwarSlab slab(B + 2 * G + 2, nw);
+            int cur = 0;
+            int64_t done = 0;
+            while (done < steps) {
+                const int64_t g = std::min(G, steps - done);
+                swar_blocked_sweep(bufs[cur], bufs[1 - cur], rows, nw,
+                                   periodic, birth, survive, g, B, b0, b1,
+                                   slab);
+                cur = 1 - cur;
+                done += g;
+                barrier.arrive_and_wait();  // all blocks of this sweep done
+            }
+        });
+    }
+    for (auto& th : threads) th.join();
+    const int64_t sweeps = (steps + G - 1) / G;
+    if (sweeps % 2)
+        std::memcpy(grid0, grid1, (size_t)(rows * nw) * 8);
 }
 
 // Fill the ghost ring of a standalone padded buffer from its own interior
@@ -242,30 +415,6 @@ void fill_ghosts_self(uint8_t* buf, int64_t rows, int64_t cols, int r, bool peri
     }
 }
 
-// ---------------------------------------------------------------------------
-// Reusable spinning-free barrier (C++17; std::barrier is C++20).
-// ---------------------------------------------------------------------------
-
-class Barrier {
-  public:
-    explicit Barrier(int n) : n_(n), waiting_(0), phase_(0) {}
-    void arrive_and_wait() {
-        std::unique_lock<std::mutex> lk(m_);
-        int phase = phase_;
-        if (++waiting_ == n_) {
-            waiting_ = 0;
-            ++phase_;
-            cv_.notify_all();
-        } else {
-            cv_.wait(lk, [&] { return phase_ != phase; });
-        }
-    }
-
-  private:
-    int n_, waiting_, phase_;
-    std::mutex m_;
-    std::condition_variable cv_;
-};
 
 // ---------------------------------------------------------------------------
 // Parallel engine: tile mesh + ghost-ring halo exchange.
@@ -381,9 +530,21 @@ void gol_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                 int radius, int periodic) {
     if (swar_eligible(cols, radius) && rows >= 1 && steps > 0) {
         const int64_t nw = cols / 64;
+        const int64_t G = std::min<int64_t>(8, steps);
+        const int64_t B = swar_pick_block_rows(nw, G);
+        if (steps >= 2 && B > 0 && rows * nw * 8 > swar_block_threshold()) {
+            // DRAM-resident grid: temporal blocking, interior-only layout
+            std::vector<uint64_t> a((size_t)(rows * nw), 0);
+            std::vector<uint64_t> b((size_t)(rows * nw), 0);
+            swar_pack(grid, a.data(), rows, cols, 0);
+            swar_evolve_blocked(a.data(), b.data(), rows, nw, periodic != 0,
+                                birth_table, survive_table, steps, B, G, 1);
+            swar_unpack(a.data(), grid, rows, cols, 0);
+            return;
+        }
         std::vector<uint64_t> a((size_t)((rows + 2) * nw), 0);
         std::vector<uint64_t> b((size_t)((rows + 2) * nw), 0);
-        swar_pack(grid, a.data(), rows, cols);
+        swar_pack(grid, a.data(), rows, cols, 1);
         SwarScratch scr(nw);
         uint64_t *cur = a.data(), *nxt = b.data();
         for (int64_t s = 0; s < steps; ++s) {
@@ -392,7 +553,7 @@ void gol_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                           birth_table, survive_table, scr);
             std::swap(cur, nxt);
         }
-        swar_unpack(cur, grid, rows, cols);
+        swar_unpack(cur, grid, rows, cols, 1);
         return;
     }
     const int r = radius;
@@ -428,9 +589,25 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
         int w = ti * tj;
         if ((int64_t)w > rows) w = (int)rows;
         const int64_t nw = cols / 64;
+        {
+            const int64_t G = std::min<int64_t>(8, std::max<int64_t>(steps, 1));
+            const int64_t B = swar_pick_block_rows(nw, G);
+            if (steps >= 2 && B > 0 && rows * nw * 8 > swar_block_threshold()) {
+                // DRAM-resident grid: temporally-blocked sweeps, workers
+                // owning disjoint block ranges with a barrier per sweep
+                std::vector<uint64_t> pa((size_t)(rows * nw), 0);
+                std::vector<uint64_t> pb((size_t)(rows * nw), 0);
+                swar_pack(grid, pa.data(), rows, cols, 0);
+                swar_evolve_blocked(pa.data(), pb.data(), rows, nw,
+                                    periodic != 0, birth_table, survive_table,
+                                    steps, B, G, w);
+                swar_unpack(pa.data(), grid, rows, cols, 0);
+                return 0;
+            }
+        }
         std::vector<uint64_t> a((size_t)((rows + 2) * nw), 0);
         std::vector<uint64_t> b((size_t)((rows + 2) * nw), 0);
-        swar_pack(grid, a.data(), rows, cols);
+        swar_pack(grid, a.data(), rows, cols, 1);
         if (steps > 0) {
             Barrier barrier(w);
             std::vector<std::thread> threads;
@@ -457,7 +634,7 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
             }
             for (auto& th : threads) th.join();
         }
-        swar_unpack(steps % 2 ? b.data() : a.data(), grid, rows, cols);
+        swar_unpack(steps % 2 ? b.data() : a.data(), grid, rows, cols, 1);
         return 0;
     }
     const int r = radius;

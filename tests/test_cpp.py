@@ -216,3 +216,37 @@ def test_cpp_swar_single_column_word_wrap():
     np.testing.assert_array_equal(
         evolve_cpp(g, 10, LIFE, "periodic"),
         evolve_np(g, 10, LIFE, "periodic"))
+
+
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+@pytest.mark.parametrize("steps", [2, 8, 10, 23])
+def test_cpp_swar_temporal_blocking(monkeypatch, boundary, steps):
+    # force the temporally-blocked sweeps (normally only for DRAM-resident
+    # grids) on a small grid: results must stay bit-identical, including
+    # dead-boundary re-kill of outside-grid slab rows, remainder sweeps
+    # (steps % 8 != 0), and the final-buffer parity
+    monkeypatch.setenv("GOLCORE_SWAR_BLOCK_THRESHOLD", "0")
+    g = init_tile_np(96, 128, seed=29)
+    np.testing.assert_array_equal(
+        evolve_cpp(g, steps, LIFE, boundary),
+        evolve_np(g, steps, LIFE, boundary))
+
+
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_cpp_swar_temporal_blocking_parallel(monkeypatch, boundary):
+    # rows > 512 forces multiple blocks (swar_pick_block_rows caps B at
+    # 512), so this genuinely runs the multithreaded branch: disjoint
+    # block ranges, barrier per sweep, cross-block halo recomputation,
+    # and the sweeps-parity final copy (11 steps = 8 + 3 remainder)
+    monkeypatch.setenv("GOLCORE_SWAR_BLOCK_THRESHOLD", "0")
+    g = init_tile_np(1088, 128, seed=31)
+    out = evolve_par_cpp(g, 11, LIFE, boundary, tiles=(2, 2))
+    np.testing.assert_array_equal(out, evolve_np(g, 11, LIFE, boundary))
+
+
+def test_cpp_swar_temporal_blocking_multiblock_serial(monkeypatch):
+    monkeypatch.setenv("GOLCORE_SWAR_BLOCK_THRESHOLD", "0")
+    g = init_tile_np(520, 128, seed=37)
+    np.testing.assert_array_equal(
+        evolve_cpp(g, 16, LIFE, "periodic"),
+        evolve_np(g, 16, LIFE, "periodic"))

@@ -17,8 +17,6 @@ I/O + visualization, and a benchmarking harness — rebuilt TPU-first:
   ``backends/native`` (C++, loaded via ctypes) — the native layer the
   reference implements with MPI.
 
-Modules land incrementally; see ``git log`` for what is built so far.
-
 Everything shares one decomposition-invariant initialization
 (``utils/hashinit.py``) so serial, native-C++, and TPU backends produce
 bit-identical grids for the same configuration.

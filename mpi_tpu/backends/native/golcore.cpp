@@ -333,6 +333,8 @@ void swar_blocked_sweep(const uint64_t* src, uint64_t* dst, int64_t rows,
 
 // Evolve an interior-only packed grid `steps` generations with temporal
 // blocking, `threads_n` workers owning disjoint block ranges per sweep.
+// One code path for any worker count (a 1-thread group pays one spawn per
+// evolve call, not per step); the final-result buffer is bufs[sweeps % 2].
 void swar_evolve_blocked(uint64_t* grid0, uint64_t* grid1, int64_t rows,
                          int64_t nw, bool periodic, const uint8_t* birth,
                          const uint8_t* survive, int64_t steps, int64_t B,
@@ -341,21 +343,6 @@ void swar_evolve_blocked(uint64_t* grid0, uint64_t* grid1, int64_t rows,
     if (threads_n > nblocks) threads_n = (int)nblocks;
     if (threads_n < 1) threads_n = 1;
     uint64_t* bufs[2] = {grid0, grid1};
-    if (threads_n == 1) {
-        SwarSlab slab(B + 2 * G + 2, nw);
-        int cur = 0;
-        int64_t done = 0;
-        while (done < steps) {
-            const int64_t g = std::min(G, steps - done);
-            swar_blocked_sweep(bufs[cur], bufs[1 - cur], rows, nw, periodic,
-                               birth, survive, g, B, 0, nblocks, slab);
-            cur = 1 - cur;
-            done += g;
-        }
-        if (cur == 1)
-            std::memcpy(grid0, grid1, (size_t)(rows * nw) * 8);
-        return;
-    }
     Barrier barrier(threads_n);
     std::vector<std::thread> threads;
     threads.reserve((size_t)threads_n);
@@ -381,6 +368,27 @@ void swar_evolve_blocked(uint64_t* grid0, uint64_t* grid1, int64_t rows,
     const int64_t sweeps = (steps + G - 1) / G;
     if (sweeps % 2)
         std::memcpy(grid0, grid1, (size_t)(rows * nw) * 8);
+}
+
+// Shared dispatch for both public entry points: run the blocked engine if
+// the grid qualifies (returns true), else leave it to the caller's plain
+// path.  Keeping the G/B/threshold policy in ONE place so the two entry
+// points cannot drift.
+bool swar_try_blocked(uint8_t* grid, int64_t rows, int64_t cols,
+                      const uint8_t* birth, const uint8_t* survive,
+                      int64_t steps, int periodic, int threads_n) {
+    const int64_t nw = cols / 64;
+    const int64_t G = std::min<int64_t>(8, steps);
+    const int64_t B = swar_pick_block_rows(nw, G);
+    if (steps < 2 || B <= 0 || rows * nw * 8 <= swar_block_threshold())
+        return false;
+    std::vector<uint64_t> a((size_t)(rows * nw), 0);
+    std::vector<uint64_t> b((size_t)(rows * nw), 0);
+    swar_pack(grid, a.data(), rows, cols, 0);
+    swar_evolve_blocked(a.data(), b.data(), rows, nw, periodic != 0, birth,
+                        survive, steps, B, G, threads_n);
+    swar_unpack(a.data(), grid, rows, cols, 0);
+    return true;
 }
 
 // Fill the ghost ring of a standalone padded buffer from its own interior
@@ -530,18 +538,9 @@ void gol_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                 int radius, int periodic) {
     if (swar_eligible(cols, radius) && rows >= 1 && steps > 0) {
         const int64_t nw = cols / 64;
-        const int64_t G = std::min<int64_t>(8, steps);
-        const int64_t B = swar_pick_block_rows(nw, G);
-        if (steps >= 2 && B > 0 && rows * nw * 8 > swar_block_threshold()) {
-            // DRAM-resident grid: temporal blocking, interior-only layout
-            std::vector<uint64_t> a((size_t)(rows * nw), 0);
-            std::vector<uint64_t> b((size_t)(rows * nw), 0);
-            swar_pack(grid, a.data(), rows, cols, 0);
-            swar_evolve_blocked(a.data(), b.data(), rows, nw, periodic != 0,
-                                birth_table, survive_table, steps, B, G, 1);
-            swar_unpack(a.data(), grid, rows, cols, 0);
+        if (swar_try_blocked(grid, rows, cols, birth_table, survive_table,
+                             steps, periodic, 1))
             return;
-        }
         std::vector<uint64_t> a((size_t)((rows + 2) * nw), 0);
         std::vector<uint64_t> b((size_t)((rows + 2) * nw), 0);
         swar_pack(grid, a.data(), rows, cols, 1);
@@ -589,22 +588,9 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
         int w = ti * tj;
         if ((int64_t)w > rows) w = (int)rows;
         const int64_t nw = cols / 64;
-        {
-            const int64_t G = std::min<int64_t>(8, std::max<int64_t>(steps, 1));
-            const int64_t B = swar_pick_block_rows(nw, G);
-            if (steps >= 2 && B > 0 && rows * nw * 8 > swar_block_threshold()) {
-                // DRAM-resident grid: temporally-blocked sweeps, workers
-                // owning disjoint block ranges with a barrier per sweep
-                std::vector<uint64_t> pa((size_t)(rows * nw), 0);
-                std::vector<uint64_t> pb((size_t)(rows * nw), 0);
-                swar_pack(grid, pa.data(), rows, cols, 0);
-                swar_evolve_blocked(pa.data(), pb.data(), rows, nw,
-                                    periodic != 0, birth_table, survive_table,
-                                    steps, B, G, w);
-                swar_unpack(pa.data(), grid, rows, cols, 0);
-                return 0;
-            }
-        }
+        if (swar_try_blocked(grid, rows, cols, birth_table, survive_table,
+                             steps, periodic, w))
+            return 0;
         std::vector<uint64_t> a((size_t)((rows + 2) * nw), 0);
         std::vector<uint64_t> b((size_t)((rows + 2) * nw), 0);
         swar_pack(grid, a.data(), rows, cols, 1);

@@ -1,0 +1,125 @@
+"""bench.py parent-orchestration logic: the driver's only perf capture
+must emit exactly one JSON line with the right degraded/error fields for
+every failure shape (VERDICT r1 item 1).  Children are stubbed out — the
+real measurement paths are covered by the engines' own parity tests."""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def no_sleep(monkeypatch):
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+
+def run_main(capsys):
+    bench.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"exactly one stdout line expected, got {lines}"
+    out = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out
+    return out
+
+
+def test_bench_happy_path(monkeypatch, capsys):
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size = int(argv[1])
+        return {"value": 2.0e12, "platform": "tpu", "size": size}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["size"] == bench.SIZES[0]
+    assert "degraded" not in out and "error" not in out
+    assert out["vs_baseline"] > 1
+
+
+def test_bench_size_fallback(monkeypatch, capsys):
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size = int(argv[1])
+        if size == bench.SIZES[0]:
+            return None, "timeout after 1200s"
+        return {"value": 1.0e12, "platform": "tpu", "size": size}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["size"] == bench.SIZES[1]
+    assert "fell back" in out["degraded"]
+
+
+def test_bench_tpu_unreachable_cpu_fallback(monkeypatch, capsys):
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return None, "timeout after 150s"
+        if cpu:
+            return {"value": 3.0e9, "platform": "cpu",
+                    "size": int(argv[1])}, "ok"
+        raise AssertionError("ladder must not run when the probe fails")
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["platform"] == "cpu"
+    assert "cpu" in out["degraded"]
+
+
+def test_bench_probe_retries_on_cpu_platform(monkeypatch, capsys):
+    # a transient plugin-init failure surfaces as platform=cpu: the probe
+    # must keep retrying, then succeed when the tunnel comes back
+    seen = []
+
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            seen.append(1)
+            if len(seen) < 3:
+                return {"platform": "cpu"}, "ok"
+            return {"platform": "tpu"}, "ok"
+        return {"value": 2.0e12, "platform": "tpu",
+                "size": int(argv[1])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert len(seen) == 3
+    assert "degraded" not in out
+
+
+def test_bench_everything_fails(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "run_sub",
+                        lambda argv, timeout, cpu=False: (None, "boom"))
+    out = run_main(capsys)
+    assert out["value"] == 0.0
+    assert out["error"] == "all attempts failed"
+    assert out["attempts"]
+
+
+def test_bench_parent_crash_still_emits_json(monkeypatch, capsys):
+    def explode(argv, timeout, cpu=False):
+        raise OSError("fork failed")
+
+    monkeypatch.setattr(bench, "run_sub", explode)
+    out = run_main(capsys)
+    assert "bench harness error" in out["error"]
+
+
+def test_bench_non_tpu_ladder_result_is_degraded(monkeypatch, capsys):
+    # belt-and-braces: even if a ladder child somehow reports a non-tpu
+    # platform, the output must carry a degraded marker
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        return {"value": 4.0e9, "platform": "cpu",
+                "size": int(argv[1])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert "non-tpu platform" in out["degraded"]

@@ -150,18 +150,41 @@ def main() -> None:
     # that counts, so even an unexpected parent-side error (fork failure,
     # malformed child output shape, ...) must still yield the JSON line.
     try:
-        _main_inner()
+        out, history = _main_inner()
     except BaseException as e:  # noqa: BLE001
-        print(json.dumps({
+        out = {
             "metric": "cell_updates_per_sec_single_chip",
             "value": 0.0,
             "unit": "cells/s",
             "vs_baseline": 0.0,
             "error": f"bench harness error: {type(e).__name__}: {e}"[:500],
-        }))
+        }
+        history = []
+    _write_artifact(out, history)
+    print(json.dumps(out))
 
 
-def _main_inner() -> None:
+def _write_artifact(out, history) -> None:
+    # side artifact for post-hoc analysis: full attempt history, kept in
+    # sync with stdout on every path including the crash guard (stdout
+    # stays exactly one JSON line for the driver).  Deliberately NOT
+    # gitignored: a fresh perf/bench_last.json left in the working tree
+    # after the driver's round-end bench run is meant to be committed as
+    # part of the round's perf record.
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.environ.get(
+            "MPI_TPU_BENCH_ARTIFACT",
+            os.path.join(here, "perf", "bench_last.json"),
+        )
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"result": out, "attempts": history}, f, indent=1)
+    except OSError:
+        pass
+
+
+def _main_inner():
     history = []
     result = None
 
@@ -231,7 +254,7 @@ def _main_inner() -> None:
     if result is None:
         out["error"] = "all attempts failed"
         out["attempts"] = history
-    print(json.dumps(out))
+    return out, history
 
 
 if __name__ == "__main__":

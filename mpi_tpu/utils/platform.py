@@ -21,3 +21,34 @@ def apply_platform_override() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+
+
+def probe_platform(timeout: float = 150.0):
+    """The default JAX platform name ("tpu", "cpu", ...) probed in a
+    subprocess with a hard timeout, or None if unreachable.
+
+    A dead TPU tunnel hangs ``jax.devices()`` indefinitely with no error,
+    and an in-process hang cannot be interrupted — every tool that wants
+    the real device must probe this way before touching JAX itself.
+
+    The probe subprocess applies the same ``MPI_TPU_PLATFORM`` override as
+    the callers' measurement children, so probe and measurement always
+    resolve the platform identically."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from mpi_tpu.utils.platform import apply_platform_override; "
+             "apply_platform_override(); "
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout, cwd=repo,
+        )
+        if proc.returncode != 0:
+            return None
+        return proc.stdout.strip().splitlines()[-1]
+    except (subprocess.TimeoutExpired, IndexError):
+        return None

@@ -4,19 +4,21 @@ every failure shape (VERDICT r1 item 1).  Children are stubbed out — the
 real measurement paths are covered by the engines' own parity tests."""
 
 import json
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, __import__("os").path.join(
-    __import__("os").path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import bench  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
-def no_sleep(monkeypatch):
+def no_sleep(monkeypatch, tmp_path):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # keep the attempt-history side artifact out of the repo's perf/
+    monkeypatch.setenv("MPI_TPU_BENCH_ARTIFACT", str(tmp_path / "bench.json"))
 
 
 def run_main(capsys):

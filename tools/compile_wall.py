@@ -79,15 +79,6 @@ def child(h: int, nw: int, bm: int, cm: int, gens: int, steps: int) -> None:
                       "gcells_per_s": round(best / 1e9, 1)}))
 
 
-def probe() -> None:
-    import jax
-
-    from mpi_tpu.utils.platform import apply_platform_override
-
-    apply_platform_override()
-    print(json.dumps({"platform": jax.devices()[0].platform}))
-
-
 def _write_out(path: str, results) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
@@ -109,15 +100,9 @@ def main(argv=None) -> int:
     # the child ever reaches its platform check, and a config that times
     # out on a hung device probe must not be recorded as a Mosaic compile
     # wall — that is the exact confusion this tool exists to resolve.
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            capture_output=True, text=True, timeout=150,
-        )
-        platform = json.loads(proc.stdout.strip().splitlines()[-1])["platform"]
-    except (subprocess.TimeoutExpired, IndexError, KeyError,
-            json.JSONDecodeError):
-        platform = None
+    from mpi_tpu.utils.platform import probe_platform
+
+    platform = probe_platform()
     if platform != "tpu":
         print(f"error: TPU unreachable (probe platform={platform!r}); "
               "refusing to record device hangs as compile walls",
@@ -170,9 +155,7 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
-        probe()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--child":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(*(int(x) for x in sys.argv[2:8]))
     else:
         sys.exit(main())

@@ -47,6 +47,14 @@ def vpu_roof(jax, jnp, lax):
 
 
 def main():
+    from mpi_tpu.utils.platform import probe_platform
+
+    platform = probe_platform()
+    if platform != "tpu":
+        print(f"error: TPU unreachable (probe platform={platform!r}); "
+              "this microbenchmark needs the real chip", file=sys.stderr)
+        return 1
+
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -93,4 +101,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

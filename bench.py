@@ -33,7 +33,9 @@ import time
 
 STEPS = 48
 GENS = 8  # temporally-blocked generations per kernel pass
+DEEP_GENS = 16  # opportunistic second measurement (keep-the-max)
 assert STEPS % GENS == 0, "throughput formula assumes STEPS exact in GENS"
+assert STEPS % DEEP_GENS == 0
 BASELINE_PER_CHIP = 1e11 / 64
 
 SIZES = (65536, 32768, 16384, 8192)  # fallback ladder
@@ -115,7 +117,8 @@ def child(size: int, steps: int, gens: int) -> None:
         int(np.asarray(evolve_pop(grid, passes)))
         dt = time.perf_counter() - t0
         best = max(best, size * size * steps / dt)
-    print(json.dumps({"value": best, "platform": platform, "size": size}))
+    print(json.dumps(
+        {"value": best, "platform": platform, "size": size, "gens": gens}))
 
 
 def run_sub(argv, timeout: float, cpu: bool = False):
@@ -140,6 +143,13 @@ def run_sub(argv, timeout: float, cpu: bool = False):
         out = json.loads(line)
         if not isinstance(out, dict):
             raise json.JSONDecodeError("not an object", line, 0)
+        if argv[0] == "--child" and not isinstance(
+            out.get("value"), (int, float)
+        ):
+            # a stray trailing log line can parse as JSON; a measurement
+            # without a numeric value must be treated as a failed attempt,
+            # never allowed to clobber an earlier good result
+            raise json.JSONDecodeError("no numeric value", line, 0)
         return out, "ok"
     except (IndexError, json.JSONDecodeError):
         return None, f"unparseable child output: {proc.stdout[-200:]!r}"
@@ -221,6 +231,19 @@ def _main_inner():
             if result is not None:
                 break
 
+    # 2b. Opportunistic deeper temporal blocking: gens=16 halves the HBM
+    #     round-trips again (PERF.md's known headroom, never measured on
+    #     hardware).  Strictly keep-the-max — a compile failure, timeout,
+    #     or slower result leaves the gens=8 number untouched.
+    if result is not None and result.get("platform") == "tpu":
+        res, note = run_sub(
+            ["--child", str(result["size"]), str(STEPS), str(DEEP_GENS)],
+            TIMEOUT_S[result["size"]],
+        )
+        history.append(f"{result['size']}g{DEEP_GENS}:{note[:160]}")
+        if res is not None and res["value"] > result["value"]:
+            result = res
+
     # 3. Degraded CPU measurement if the TPU path produced nothing.
     degraded = None
     if result is None:
@@ -249,6 +272,8 @@ def _main_inner():
     if result:
         out["size"] = result["size"]
         out["platform"] = result["platform"]
+        if "gens" in result:
+            out["gens"] = result["gens"]
     if degraded:
         out["degraded"] = degraded
     if result is None:

@@ -125,3 +125,62 @@ def test_bench_non_tpu_ladder_result_is_degraded(monkeypatch, capsys):
     monkeypatch.setattr(bench, "run_sub", fake)
     out = run_main(capsys)
     assert "non-tpu platform" in out["degraded"]
+
+
+def test_bench_deep_gens_keeps_max(monkeypatch, capsys):
+    # the opportunistic gens=16 attempt replaces the result only when
+    # faster; its failure must never disturb the gens=8 number
+    def fake_faster(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        gens = int(argv[3])
+        return {"value": 1.5e12 if gens == bench.DEEP_GENS else 1.0e12,
+                "platform": "tpu", "size": int(argv[1]), "gens": gens}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake_faster)
+    out = run_main(capsys)
+    assert out["gens"] == bench.DEEP_GENS and out["value"] == 1.5e12
+
+    def fake_slower_or_failing(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        gens = int(argv[3])
+        if gens == bench.DEEP_GENS:
+            return None, "timeout after 1200s"  # Mosaic wall: keep gens=8
+        return {"value": 1.0e12, "platform": "tpu",
+                "size": int(argv[1]), "gens": gens}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake_slower_or_failing)
+    out = run_main(capsys)
+    assert out["gens"] == bench.GENS and out["value"] == 1.0e12
+
+
+def test_bench_run_sub_rejects_valueless_child_json():
+    # a parseable trailing line without a numeric "value" must be a failed
+    # attempt, not a result that can clobber a good measurement
+    class P:
+        returncode = 0
+        stdout = '{"note": "tpu runtime shutting down"}\n'
+        stderr = ""
+
+    import subprocess
+
+    real = subprocess.run
+    try:
+        subprocess.run = lambda *a, **k: P()
+        res, note = bench.run_sub(["--child", "8192", "48", "8"], 10)
+    finally:
+        subprocess.run = real
+    assert res is None and "unparseable" in note
+    # probe results have no "value" and must still parse
+    class P2:
+        returncode = 0
+        stdout = '{"platform": "tpu"}\n'
+        stderr = ""
+
+    try:
+        subprocess.run = lambda *a, **k: P2()
+        res, note = bench.run_sub(["--probe"], 10)
+    finally:
+        subprocess.run = real
+    assert res == {"platform": "tpu"}

@@ -119,13 +119,17 @@ def test_two_process_multihost_packed_engine(tmp_path):
     np.testing.assert_array_equal(final, ref)
 
 
-def test_two_process_multihost_resume(tmp_path):
+@pytest.mark.parametrize("rows,cols,name", [
+    (32, 32, "ckpt"),    # dense engine (shard width not word-aligned)
+    (64, 256, "pck"),    # bitpacked engine (_put_initial packs regions)
+])
+def test_two_process_multihost_resume(tmp_path, rows, cols, name):
     # checkpoint-resume across a process group: each host loads only the
     # snapshot regions of its addressable shards (golio.assemble_region +
     # make_array_from_single_device_arrays), no host-global grid
-    _run_group(str(tmp_path), ["32", "32", "8", "8", "--name", "ckpt"])
-    _run_group(str(tmp_path), ["32", "32", "8", "8", "--name", "ckpt",
-                               "--resume", "ckpt@8"])
-    final = golio.assemble(str(tmp_path), "ckpt", 16)
-    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    base = [str(rows), str(cols), "8", "8", "--name", name]
+    _run_group(str(tmp_path), base)
+    _run_group(str(tmp_path), base + ["--resume", f"{name}@8"])
+    final = golio.assemble(str(tmp_path), name, 16)
+    ref = evolve_np(init_tile_np(rows, cols, seed=5), 16, LIFE, "periodic")
     np.testing.assert_array_equal(final, ref)

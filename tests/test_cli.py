@@ -263,3 +263,12 @@ def test_cli_strict_fails_before_side_effects(tmp_path):
                "--out-dir", str(out), "--quiet"])
     assert rc == 2
     assert not out.exists()
+
+
+def test_cli_profile_writes_trace(tmp_path):
+    prof = tmp_path / "trace"
+    rc = main(["32", "32", "8", "4", "--backend", "tpu", "--quiet",
+               "--out-dir", str(tmp_path), "--profile", str(prof)])
+    assert rc == 0
+    # jax.profiler.trace writes a plugins/profile/<ts>/ tree
+    assert any(prof.rglob("*")), "profile trace directory is empty"

@@ -34,6 +34,7 @@ NAME=${NAME:-batch-${GRID}x${GRID}-${ITERS}-s${SEED}}
 SAVE_FLAG=--save
 [ "$SAVE" = 0 ] && SAVE_FLAG=
 
-python -m mpi_tpu.cli "$GRID" "$GRID" "$GAP" "$ITERS" batch_timings "${FIRST:-1}" \
+# PYTHON override: test harnesses / venvs pin the exact interpreter
+"${PYTHON:-python}" -m mpi_tpu.cli "$GRID" "$GRID" "$GAP" "$ITERS" batch_timings "${FIRST:-1}" \
   --backend tpu --seed "$SEED" --name "$NAME" $SAVE_FLAG \
   ${MULTIHOST:+--multihost} --out-dir "${OUT_DIR:-.}"

@@ -273,3 +273,28 @@ def test_cli_profile_writes_trace(tmp_path):
     # jax.profiler.trace writes a plugins/profile/<ts>/ tree; assert on
     # actual trace FILES — bare directories must not pass the smoke
     assert any(p.is_file() for p in prof.rglob("*")), "no trace files"
+
+
+def test_batch_script_runs(tmp_path):
+    # gol.batch.sh (the reference's gol.pbs analog) end-to-end on a tiny
+    # config: must produce an assemblable snapshot series
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    # pin every knob the script reads, so ambient shell state (an exported
+    # SAVE=0, NAME, MULTIHOST, ...) cannot change what this test executes
+    for knob in ("NAME", "MULTIHOST"):
+        env.pop(knob, None)
+    env.update(GRID="64", ITERS="8", GAP="4", SEED="3", SAVE="1", FIRST="1",
+               OUT_DIR=str(tmp_path), PYTHON=_sys.executable,
+               PYTHONPATH=repo, MPI_TPU_PLATFORM="cpu",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(["bash", os.path.join(repo, "gol.batch.sh")],
+                       capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    final = golio.assemble(str(tmp_path), "batch-64x64-8-s3", 8)
+    ref = evolve_np(init_tile_np(64, 64, seed=3), 8, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)

@@ -41,6 +41,7 @@ BASELINE_PER_CHIP = 1e11 / 64
 SIZES = (65536, 32768, 16384, 8192)  # fallback ladder
 ATTEMPTS_PER_SIZE = 2
 BACKOFF_S = (5.0, 20.0)
+RECOVERY_WAIT_S = 120.0  # endpoint-recovery pause after a fast-failing ladder
 TIMEOUT_S = {65536: 1200, 32768: 900, 16384: 720, 8192: 600}
 PROBE_ATTEMPTS = 3
 PROBE_TIMEOUT_S = 150
@@ -216,12 +217,14 @@ def _main_inner():
             time.sleep(PROBE_BACKOFF_S[min(i, len(PROBE_BACKOFF_S) - 1)])
 
     # 2. Size ladder on the real device.
+    ladder_timed_out = False
     if tpu_ok:
         for size in SIZES:
             for i in range(ATTEMPTS_PER_SIZE):
                 res, note = run_sub(
                     ["--child", str(size), str(STEPS), str(GENS)], TIMEOUT_S[size]
                 )
+                ladder_timed_out = ladder_timed_out or note.startswith("timeout")
                 history.append(f"{size}:{note[:160]}")
                 if res is not None:
                     result = res
@@ -230,6 +233,22 @@ def _main_inner():
                     time.sleep(BACKOFF_S[min(i, len(BACKOFF_S) - 1)])
             if result is not None:
                 break
+
+    # 2a. Endpoint-recovery retry: round 1 failed with a healthy device
+    #     but a refused remote-compile endpoint — if every ladder attempt
+    #     failed FAST that way (no slow timeouts: a timed-out ladder
+    #     already burned hours and will not benefit from one more try),
+    #     give the endpoint one longer window to recover before
+    #     surrendering to the CPU fallback.
+    if result is None and tpu_ok and not ladder_timed_out:
+        time.sleep(RECOVERY_WAIT_S)
+        res, note = run_sub(
+            ["--child", str(SIZES[0]), str(STEPS), str(GENS)],
+            TIMEOUT_S[SIZES[0]],
+        )
+        history.append(f"recovery-{SIZES[0]}:{note[:160]}")
+        if res is not None:
+            result = res
 
     # 2b. Opportunistic deeper temporal blocking: gens=16 halves the HBM
     #     round-trips again (PERF.md's known headroom, never measured on

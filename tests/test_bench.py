@@ -184,3 +184,44 @@ def test_bench_run_sub_rejects_valueless_child_json():
     finally:
         subprocess.run = real
     assert res == {"platform": "tpu"}
+
+
+def test_bench_endpoint_recovery_retry(monkeypatch, capsys):
+    # probe says tpu but every ladder attempt fails (refused remote-compile
+    # endpoint): one recovery attempt at the flagship size fires before the
+    # CPU fallback, and its success yields an undegraded result
+    calls = []
+
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        calls.append(tuple(argv))
+        if len(calls) <= bench.ATTEMPTS_PER_SIZE * len(bench.SIZES):
+            return None, "UNAVAILABLE: remote_compile refused"
+        return {"value": 2.0e12, "platform": "tpu",
+                "size": int(argv[1]), "gens": int(argv[3])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["size"] == bench.SIZES[0]
+    assert "degraded" not in out
+
+
+def test_bench_no_recovery_retry_after_ladder_timeouts(monkeypatch, capsys):
+    # a ladder that burned hard timeouts must go straight to the CPU
+    # fallback, not spend another recovery window on the flagship size
+    calls = []
+
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        if cpu:
+            return {"value": 3.0e9, "platform": "cpu",
+                    "size": int(argv[1])}, "ok"
+        calls.append(1)
+        return None, f"timeout after {timeout:.0f}s"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert len(calls) == bench.ATTEMPTS_PER_SIZE * len(bench.SIZES)
+    assert out["platform"] == "cpu"

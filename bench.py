@@ -31,14 +31,22 @@ import subprocess
 import sys
 import time
 
-STEPS = 48
 GENS = 8  # temporally-blocked generations per kernel pass
 DEEP_GENS = 16  # opportunistic second measurement (keep-the-max)
-assert STEPS % GENS == 0, "throughput formula assumes STEPS exact in GENS"
-assert STEPS % DEEP_GENS == 0
 BASELINE_PER_CHIP = 1e11 / 64
 
 SIZES = (65536, 32768, 16384, 8192)  # fallback ladder
+# Dispatch over the device tunnel costs ~70 ms per executable call
+# (measured 2026-07-30: 48 steps at 16384^2 -> 176 Gcell/s, 480 steps ->
+# 1049, back-solving to ~115 us/step compute + 68 ms fixed overhead), so
+# short timed runs under-report by up to 10x.  Steps scale inversely with
+# grid AREA (4x per size halving) — every rung then times the same ~8e12
+# cell-updates, i.e. a ~4 s window at the ~2 Tcell/s the kernel runs at,
+# keeping the fixed per-call cost under 2%.
+STEPS_BY_SIZE = {65536: 1920, 32768: 7680, 16384: 30720, 8192: 122880}
+assert all(s % GENS == 0 and s % DEEP_GENS == 0
+           for s in STEPS_BY_SIZE.values()), \
+    "throughput formula assumes steps exact in gens"
 ATTEMPTS_PER_SIZE = 2
 BACKOFF_S = (5.0, 20.0)
 RECOVERY_WAIT_S = 120.0  # endpoint-recovery pause after a fast-failing ladder
@@ -222,7 +230,8 @@ def _main_inner():
         for size in SIZES:
             for i in range(ATTEMPTS_PER_SIZE):
                 res, note = run_sub(
-                    ["--child", str(size), str(STEPS), str(GENS)], TIMEOUT_S[size]
+                    ["--child", str(size), str(STEPS_BY_SIZE[size]),
+                     str(GENS)], TIMEOUT_S[size]
                 )
                 ladder_timed_out = ladder_timed_out or note.startswith("timeout")
                 history.append(f"{size}:{note[:160]}")
@@ -243,7 +252,8 @@ def _main_inner():
     if result is None and tpu_ok and not ladder_timed_out:
         time.sleep(RECOVERY_WAIT_S)
         res, note = run_sub(
-            ["--child", str(SIZES[0]), str(STEPS), str(GENS)],
+            ["--child", str(SIZES[0]), str(STEPS_BY_SIZE[SIZES[0]]),
+             str(GENS)],
             TIMEOUT_S[SIZES[0]],
         )
         history.append(f"recovery-{SIZES[0]}:{note[:160]}")
@@ -251,12 +261,15 @@ def _main_inner():
             result = res
 
     # 2b. Opportunistic deeper temporal blocking: gens=16 halves the HBM
-    #     round-trips again (PERF.md's known headroom, never measured on
-    #     hardware).  Strictly keep-the-max — a compile failure, timeout,
-    #     or slower result leaves the gens=8 number untouched.
+    #     round-trips again.  Measured 2026-07-30: it did NOT beat gens=8
+    #     at 65536^2 (the kernel is compute-bound; see PERF.md) — kept
+    #     because it is strictly keep-the-max (a compile failure, timeout,
+    #     or slower result leaves the gens=8 number untouched) and a
+    #     future kernel may tip the balance.
     if result is not None and result.get("platform") == "tpu":
         res, note = run_sub(
-            ["--child", str(result["size"]), str(STEPS), str(DEEP_GENS)],
+            ["--child", str(result["size"]),
+             str(STEPS_BY_SIZE[result["size"]]), str(DEEP_GENS)],
             TIMEOUT_S[result["size"]],
         )
         history.append(f"{result['size']}g{DEEP_GENS}:{note[:160]}")

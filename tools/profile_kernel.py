@@ -76,8 +76,10 @@ def main():
         return jnp.sum(lax.population_count(out).astype(jnp.uint32))
 
     for side in (4096, 8192, 16384, 32768, 65536):
-        # enough steps that the ~70 ms tunnel round-trip is <2% of the call
-        steps = max(64, min(2048, int(2**31 / (side * side) * 64)))
+        # a constant ~8e12 cell-update budget per timed call (~4 s at the
+        # ~2 Tcell/s this kernel runs at) keeps the ~70 ms fixed tunnel
+        # round-trip under 2% of the call at every size
+        steps = max(gens, int(8e12 / (side * side)))
         steps -= steps % gens
         packed = init_packed(side, side, seed=1)
         t0 = time.perf_counter()

@@ -46,22 +46,34 @@ def _pick_blocks(H: int, NW: int, gens: int = 1) -> tuple[int, int] | None:
     BM bounds the double-buffered HBM↔VMEM slabs — bigger is better (DMA
     amortization, and with temporal blocking the whole slab is reused for
     ``gens`` generations).  CM bounds the live compute temporaries: each
-    generation is evaluated over sub-tiles of CM rows, so the working set
-    is ~13.5 live (rows, NW) u32 arrays for single-tile windows and ~16
-    for sub-tiled ones (the saved-row carry and concat add live copies) —
-    calibrated against Mosaic's scoped-vmem accounting ((BM=128, NW=2048,
-    gens=4) single-tile reports 16.29M over the 16M limit and (BM=512,
-    CM=64, NW=2048, gens=1) reports 16.25M, both rejected; (BM=512,
-    CM=256, NW=512, gens=8) and (BM=64, single-tile, NW=2048, gens=8)
-    compile and are kept).
+    generation is evaluated over sub-tiles of CM rows.
 
-    Wide rows (NW > 512) use single-tile windows only: sub-tiled kernels
-    there hit pathological Mosaic compile times (a (256, 64) kernel at
-    NW=2048 did not finish compiling in 9 minutes, while single-tile
-    variants compile in ~1-2).  Narrow rows prefer the largest CM first —
-    big compute tiles both run fastest (measured: (512, 256) at NW=512
-    beats every (·, ≤64) shape) and bound the unrolled sub-tile count —
-    then the largest slab BM that still fits."""
+    Narrow rows (NW ≤ 512) model the working set as ~13.5 live (rows,
+    NW) u32 arrays for single-tile windows and ~16 for sub-tiled ones
+    (the saved-row carry and concat add live copies) — calibrated
+    against Mosaic's scoped-vmem accounting ((BM=128, NW=2048, gens=4)
+    single-tile reports 16.29M over the 16M limit; (BM=512, CM=256,
+    NW=512, gens=8) compiles and is kept).  They prefer the largest CM
+    first — big compute tiles both run fastest (measured: (512, 256) at
+    NW=512 beats every (·, ≤64) shape) and bound the unrolled sub-tile
+    count — then the largest slab BM that still fits.
+
+    Wide rows (NW > 512) use an empirical whitelist, not a model: the
+    round-1 Mosaic compile-time pathology is gone (the full (BM, CM) ×
+    gens map at NW=2048 compiles in under ~40 s per config: 0.9-2 s at
+    gens=1, 6.5-40 s at gens=8 — `perf/compile_wall.json`, 2026-07-30)
+    and the hard boundary is VMEM
+    OOM, which is NOT linear in the tile rows: (512, 64) at gens=1 OOMs
+    (Mosaic reports 16.25M) while (128, 128) at gens=8 — more modeled
+    rows — compiles.  No single per-row coefficient separates the two,
+    so the preference list carries only measured-OK shapes, every
+    512-row slab is measured OOM at NW=2048 (hence the hard bm ≤ 256
+    guard), and the coefficient-11 screen exists only to scale the
+    whitelisted shapes' budget with ``gens`` (calibrated at gens ≥ 4:
+    (512, 64) est. 16.0 MB → OOM, (128, 128) est. 15.5 MB → OK).
+    Measured preference at NW=2048: (128, 128) at deep temporal
+    blocking (1940 vs 1850 Gcell/s for the best single-tile slab at
+    gens=8), (256, 64) shallow (1211 vs 1170 at gens=1)."""
     sizes = (512, 256, 128, 64, 32, 16, 8)
     halo = _halo_rows(gens)
 
@@ -70,6 +82,33 @@ def _pick_blocks(H: int, NW: int, gens: int = 1) -> tuple[int, int] | None:
         return H % bm == 0 and (halo <= 8 or (H % halo == 0 and bm % halo == 0))
 
     if NW > 512:
+        # The whitelist below was measured in the halo-8 regime
+        # (gens ∈ {1, 8}); calibration shows the screen under-predicts
+        # Mosaic's accounting by ~0.25 MB, so in the unmeasured halo-16
+        # regime (gens > 8) demand double that (0.5 MB) as headroom
+        # rather than admit a shape on a 32 KB margin
+        limit = int(15.25 * (1 << 20)) - (512 * 1024 if halo > 8 else 0)
+        # ((256, 64) is omitted from the deep-blocking list: any shape
+        # for which it passes bm_ok and the screen is always preceded by
+        # a passing (128, 64) — same temps, smaller slab — so it could
+        # never be selected there)
+        prefs = (
+            ((128, 128), (128, 64)) if gens >= 4
+            else ((256, 64), (128, 128), (128, 64))
+        )
+        for bm, cm in prefs:
+            # bm > 256 is measured VMEM OOM at wide NW for every CM and
+            # gens (perf/compile_wall.json) — keep the rail even if the
+            # prefs list is extended, because the coefficient screen
+            # below cannot predict those OOMs (see docstring)
+            if bm > 256 or not bm_ok(bm):
+                continue
+            need = (2 * (bm + 2 * halo) * NW * 4
+                    + 11 * (cm + 2 * gens + 2) * NW * 4)
+            if need <= limit:
+                return bm, cm
+        # single-tile fallback for shapes the preferred sub-tiles can't
+        # serve (e.g. H not a multiple of 128)
         limit = int(15.75 * (1 << 20))
         for bm in sizes:
             if not bm_ok(bm):

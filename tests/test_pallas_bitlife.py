@@ -8,6 +8,7 @@ import pytest
 from mpi_tpu.models.rules import LIFE, HIGHLIFE, SEEDS, Rule
 from mpi_tpu.ops.bitlife import pack_np, unpack_np
 from mpi_tpu.ops.pallas_bitlife import (
+    _halo_rows,
     _pick_block_rows,
     _pick_blocks,
     make_pallas_bit_stepper,
@@ -121,14 +122,22 @@ def test_gens_bounds():
 def test_supports_and_blocks():
     assert supports((65536, 65536), LIFE)
     assert not supports((65536, 65536 + 32), LIFE)  # packed width not lane-aligned
-    # wide rows: single-tile windows only (CM covers BM + 2·(gens−1));
-    # narrow rows: sub-tiled with the largest compute tile first
-    bm, cm = _pick_blocks(65536, 2048, 8)
+    # wide rows: sub-tiled picks calibrated against the measured VMEM
+    # OOM/OK boundary and throughput map (perf/compile_wall.json)
+    assert _pick_blocks(65536, 2048, 8) == (128, 128)
+    assert _pick_blocks(65536, 2048, 1) == (256, 64)
+    assert _pick_blocks(65536, 2048, 16) == (128, 64)
+    # H not a multiple of the preferred sub-tile slabs → single-tile
+    bm, cm = _pick_blocks(192, 2048, 8)
     assert cm == bm + 16
+    # narrow rows: sub-tiled with the largest compute tile first
     assert _pick_blocks(16384, 512, 8) == (512, 256)
     assert _pick_blocks(4096, 128, 1) == (512, 512)
     # modeled working set of a tile must stay under the 16 MiB VMEM
     for nw, gens in ((2048, 1), (2048, 8), (512, 8), (128, 4)):
         bm, cm = _pick_blocks(65536, nw, gens)
-        rows = min(cm, bm + 2 * gens - 2) + 2
-        assert 2 * (bm + 16) * nw * 4 + 16 * (rows + 2) * nw * 4 <= 16.5 * (1 << 20)
+        halo = _halo_rows(gens)
+        coeff = 11 if nw > 512 else 16
+        rows = cm + 2 * gens + 2
+        assert (2 * (bm + 2 * halo) * nw * 4
+                + coeff * rows * nw * 4) <= 15.75 * (1 << 20)

@@ -1,17 +1,20 @@
 #!/usr/bin/env python
 """Mosaic compile-time wall experiment (VERDICT r1 item 8).
 
-Sub-tiled packed kernels at NW > 512 hit pathological Mosaic compile
-times (a (BM=256, CM=64) kernel at NW=2048 did not finish compiling in
-9 minutes), so ``_pick_blocks`` currently disables sub-tiling wholesale
-for wide rows.  This tool produces the measurement that decision should
-rest on: a (BM, CM) × NW × gens table of
+Round 1 observed pathological Mosaic compile times for sub-tiled packed
+kernels at NW > 512 (a (BM=256, CM=64) kernel at NW=2048 did not finish
+compiling in 9 minutes).  This tool measures the (BM, CM) × NW × gens
+table of
 
   * compile seconds (or TIMEOUT),
-  * steady-state Gcell/s for the configs that do compile,
+  * steady-state Gcell/s for the configs that do compile.
 
-so the next perf push can either enable faster wide configs in
-``_pick_blocks`` or keep single-tile with numbers to point at.
+The 2026-07-30 run (`perf/compile_wall.json`) showed the pathology does
+NOT reproduce — every config compiles in under ~40 s or fails fast with
+a VMEM OOM — and ``_pick_blocks`` now prefers the measured sub-tiled
+winners for wide rows, calibrated against that artifact.  Keep the tool:
+it is the way to re-map the boundary after a toolchain bump or a kernel
+change.
 
 Each config compiles in its own subprocess with a hard timeout — a
 Mosaic hang must cost one config, not the run.  Needs a real TPU; a

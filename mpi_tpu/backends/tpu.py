@@ -228,7 +228,13 @@ def run_tpu(
     compiled = {}
     for n in sorted(set(segments)):
         compiled[n] = evolve.lower(grid, n).compile()
-    jax.block_until_ready(grid)
+
+    from mpi_tpu.utils.platform import force_fetch
+
+    # Timed regions must close with a real fetch, not block_until_ready
+    # (see force_fetch); the warm call here also compiles the tiny slice
+    # executables inside the setup-timed phase.
+    force_fetch(grid)
     timer.setup_done()
 
     unpacker = make_sharded_unpacker(mesh) if packed_mode and want_snapshots else None
@@ -243,9 +249,10 @@ def run_tpu(
         grid = compiled[n](grid)
         it += n
         if want_snapshots:
-            jax.block_until_ready(grid)
+            # tiles_of's np.asarray(shard.data) fetches are the real
+            # barrier here; no block_until_ready needed (or trusted)
             snapshot_cb(it, tiles_of(grid))
-    jax.block_until_ready(grid)
+    force_fetch(grid)
     timer.finish()
     if jax.process_count() > 1:
         # the global array spans non-addressable devices; hosts keep their

@@ -23,6 +23,25 @@ def apply_platform_override() -> None:
         jax.config.update("jax_platforms", plat)
 
 
+def force_fetch(g) -> None:
+    """Synchronize on a ``jax.Array`` with a real device→host fetch.
+
+    On the tunneled platform ``jax.block_until_ready`` can return before
+    the device finishes, so a timed region closed with it reports
+    physically impossible throughput; only an actual data fetch is a
+    reliable barrier (bench.py's scalar-popcount fetch is the same
+    idea).  One element is fetched from EVERY addressable shard — a
+    single-shard fetch would only synchronize that shard's device — in
+    one batched ``device_get`` so the high-latency transport is paid one
+    round-trip, not one per shard."""
+    import jax
+
+    jax.device_get([
+        s.data[(slice(0, 1),) * s.data.ndim]
+        for s in g.addressable_shards
+    ])
+
+
 def probe_platform(timeout: float = 150.0):
     """The default JAX platform name ("tpu", "cpu", ...) probed in a
     subprocess with a hard timeout, or None if unreachable.

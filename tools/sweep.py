@@ -55,6 +55,8 @@ if _VIRTUAL:
 
 import numpy as np  # noqa: E402
 
+from mpi_tpu.utils.platform import force_fetch  # noqa: E402
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
@@ -123,10 +125,12 @@ def main(argv=None) -> int:
                 overlap=args.overlap,
             )
         compiled = evolve.lower(grid, args.steps).compile()
-        jax.block_until_ready(grid)
+        # real fetches, not block_until_ready: the latter can return
+        # early on the tunneled platform (see utils.platform.force_fetch)
+        force_fetch(grid)
         timer.setup_done()
         out = compiled(grid)
-        jax.block_until_ready(out)
+        force_fetch(out)
         timer.finish()
 
         cps = timer.cells_per_sec(rows, cols, args.steps)

@@ -41,3 +41,17 @@ def test_write_reports_aggregated_durations(tmp_path):
     assert "Single time (rank 0): 1000us" in detailed
     assert "Avg single time: 1200us" in detailed
     assert "Summed time: 2400us" in detailed
+
+
+def test_force_fetch_synchronizes_any_ndim():
+    # force_fetch must accept any array rank (it closes timed regions for
+    # grids, packed grids, and scalar reductions alike) and return only
+    # after real data is fetchable from every addressable shard
+    import jax.numpy as jnp
+
+    from mpi_tpu.utils.platform import force_fetch
+
+    for arr in (jnp.arange(8.0), jnp.zeros((4, 4)),
+                jnp.zeros((2, 3, 4), dtype=jnp.uint32),
+                jnp.asarray(3.5)):
+        force_fetch(arr + 1)

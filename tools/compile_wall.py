@@ -27,7 +27,6 @@ non-TPU platform fails fast per config.
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -44,7 +43,6 @@ def child(h: int, nw: int, bm: int, cm: int, gens: int, steps: int) -> None:
     apply_platform_override()
     import jax.numpy as jnp
     from jax import lax
-    import numpy as np
 
     from mpi_tpu.models.rules import LIFE
     from mpi_tpu.ops.bitlife import init_packed
@@ -65,27 +63,13 @@ def child(h: int, nw: int, bm: int, cm: int, gens: int, steps: int) -> None:
         )
         return jnp.sum(lax.population_count(out).astype(jnp.uint32))
 
-    grid = init_packed(h, nw * 32, seed=1)
-    t0 = time.perf_counter()
-    compiled = one.lower(grid).compile()
-    compile_s = time.perf_counter() - t0
+    from scan_common import time_compiled
 
-    int(np.asarray(compiled(grid)))  # warm
-    best = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        int(np.asarray(compiled(grid)))
-        dt = time.perf_counter() - t0
-        eff_steps = max(1, steps // gens) * gens
-        best = max(best, h * nw * 32 * eff_steps / dt)
+    grid = init_packed(h, nw * 32, seed=1)
+    eff_steps = max(1, steps // gens) * gens
+    compile_s, best = time_compiled(one, grid, h * nw * 32 * eff_steps)
     print(json.dumps({"compile_s": round(compile_s, 2),
                       "gcells_per_s": round(best / 1e9, 1)}))
-
-
-def _write_out(path: str, results) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(results, f, indent=1)
 
 
 def main(argv=None) -> int:
@@ -103,13 +87,9 @@ def main(argv=None) -> int:
     # the child ever reaches its platform check, and a config that times
     # out on a hung device probe must not be recorded as a Mosaic compile
     # wall — that is the exact confusion this tool exists to resolve.
-    from mpi_tpu.utils.platform import probe_platform
+    from scan_common import require_tpu, run_child, write_out
 
-    platform = probe_platform()
-    if platform != "tpu":
-        print(f"error: TPU unreachable (probe platform={platform!r}); "
-              "refusing to record device hangs as compile walls",
-              file=sys.stderr)
+    if not require_tpu():
         return 1
 
     nw = args.w // 32
@@ -128,31 +108,17 @@ def main(argv=None) -> int:
                 tag = dict(nw=nw, gens=gens, bm=bm,
                            cm="single" if cm is None else cm)
                 t0 = time.perf_counter()
-                try:
-                    proc = subprocess.run(
-                        [sys.executable, os.path.abspath(__file__), "--child",
-                         str(args.h), str(nw), str(bm), str(eff_cm),
-                         str(gens), str(args.steps)],
-                        capture_output=True, text=True, timeout=args.timeout,
-                    )
-                    if proc.returncode == 0:
-                        try:
-                            tag.update(json.loads(
-                                proc.stdout.strip().splitlines()[-1]))
-                        except (IndexError, json.JSONDecodeError):
-                            tag["error"] = (
-                                f"unparseable child output: {proc.stdout[-200:]!r}")
-                    else:
-                        err = (proc.stderr or "").strip().splitlines()
-                        tag["error"] = err[-1][:200] if err else f"rc={proc.returncode}"
-                except subprocess.TimeoutExpired:
-                    tag["error"] = f"TIMEOUT>{args.timeout:.0f}s"
+                res = run_child(
+                    __file__, (args.h, nw, bm, eff_cm, gens, args.steps),
+                    args.timeout,
+                )
+                tag.update(res)
                 tag["wall_s"] = round(time.perf_counter() - t0, 1)
                 results.append(tag)
                 print(json.dumps(tag), flush=True)
                 # incremental: a crash or ^C hours in must not lose the
                 # configs already measured (each costs up to --timeout)
-                _write_out(args.out, results)
+                write_out(args.out, results)
     print(f"wrote {args.out} ({len(results)} configs)", file=sys.stderr)
     return 0
 

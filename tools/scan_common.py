@@ -1,0 +1,73 @@
+"""Shared harness for the TPU measurement scan tools (compile_wall,
+width_scan): probe-gated subprocess children with hard timeouts, guarded
+stdout parsing, and incremental artifact writes — a hung or crashed
+config must cost one config, not the scan, and a partial run must leave
+its completed measurements on disk."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def run_child(script: str, argv, timeout: float) -> dict:
+    """Run ``script --child *argv`` and return its parsed JSON line, or
+    an ``{"error": ...}`` dict for any failure shape (timeout, nonzero
+    exit, unparseable stdout)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(script), "--child",
+             *[str(a) for a in argv]],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"TIMEOUT>{timeout:.0f}s"}
+    if proc.returncode != 0:
+        err = (proc.stderr or "").strip().splitlines()
+        return {"error": err[-1][:200] if err else f"rc={proc.returncode}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (IndexError, json.JSONDecodeError):
+        return {"error": f"unparseable child output: {proc.stdout[-200:]!r}"}
+
+
+def time_compiled(jitted, grid, cells_per_call):
+    """Shared child measurement protocol: AOT-compile (timed separately
+    from execution), warm once, then best-of-3 throughput.  The scalar
+    ``int(np.asarray(...))`` fetch is the real completion barrier on the
+    tunneled platform (see ``mpi_tpu.utils.platform.force_fetch``).
+    Returns ``(compile_s, best_cells_per_s)``."""
+    import time
+
+    import numpy as np
+
+    t0 = time.perf_counter()
+    compiled = jitted.lower(grid).compile()
+    compile_s = time.perf_counter() - t0
+    int(np.asarray(compiled(grid)))  # warm
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(np.asarray(compiled(grid)))
+        best = max(best, cells_per_call / (time.perf_counter() - t0))
+    return compile_s, best
+
+
+def write_out(path: str, results) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+def require_tpu() -> bool:
+    """Gate a scan on device reachability so a hung tunnel is never
+    recorded as a per-config failure."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from mpi_tpu.utils.platform import probe_platform
+
+    platform = probe_platform()
+    if platform != "tpu":
+        print(f"error: TPU unreachable (probe platform={platform!r})",
+              file=sys.stderr)
+        return False
+    return True

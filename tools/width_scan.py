@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Map the SWAR Pallas kernel's throughput over grid shape (H × NW).
+
+Round-2 finding: per-cell throughput falls with packed row width NW even
+at fixed (BM, CM) blocks — the full-width lane rotations and wider live
+rows in ``sub_gen`` are intrinsic per-word costs — and tall grids pay a
+further ~9% at fixed width (more grid-loop iterations per pass).  This
+scan is the measurement behind PERF.md's "width penalty" section and the
+reason a column-panel decomposition was rejected (the tall-narrow
+configuration it would emulate measures only ~2% above the wide-row
+kernel at 65536²-equivalent area).
+
+Each (H, NW) cell times a constant ~8e12 cell-update budget (dispatch
+amortization, see PERF.md) at gens=8 with auto-picked blocks.
+
+    python tools/width_scan.py --out perf/width_scan.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SHAPES = (
+    (16384, 512), (16384, 1024), (16384, 2048),
+    (65536, 512), (65536, 2048), (32768, 1024),
+)
+
+
+def child(H: int, NW: int, gens: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    from mpi_tpu.models.rules import LIFE
+    from mpi_tpu.ops.bitlife import init_packed
+    from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, _pick_blocks
+
+    if jax.devices()[0].platform != "tpu":
+        raise RuntimeError("width scan needs the real chip")
+    steps = max(gens, int(8e12 / (H * NW * 32)))
+    steps -= steps % gens
+
+    @jax.jit
+    def evolve_pop(p):
+        out, _ = lax.scan(
+            lambda x, _: (pallas_bit_step(x, LIFE, "periodic", gens=gens), None),
+            p, None, length=steps // gens,
+        )
+        return jnp.sum(lax.population_count(out).astype(jnp.uint32))
+
+    from scan_common import time_compiled
+
+    grid = init_packed(H, NW * 32, seed=1)
+    compile_s, best = time_compiled(evolve_pop, grid, H * NW * 32 * steps)
+    print(json.dumps({
+        "H": H, "NW": NW, "gens": gens,
+        "blocks": list(_pick_blocks(H, NW, gens) or ()),
+        "gcells_per_s": round(best / 1e9, 1),
+        "compile_s": round(compile_s, 1),
+    }))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--gens", type=int, default=8)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--out", default="perf/width_scan.json")
+    args = p.parse_args(argv)
+
+    from scan_common import require_tpu, run_child, write_out
+
+    if not require_tpu():
+        return 1
+
+    results = []
+    for H, NW in SHAPES:
+        res = run_child(__file__, (H, NW, args.gens), args.timeout)
+        if "error" in res:
+            res = {"H": H, "NW": NW, **res}
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        write_out(args.out, results)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+        sys.exit(0)
+    sys.exit(main())

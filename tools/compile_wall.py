@@ -41,33 +41,22 @@ def child(h: int, nw: int, bm: int, cm: int, gens: int, steps: int) -> None:
     from mpi_tpu.utils.platform import apply_platform_override
 
     apply_platform_override()
-    import jax.numpy as jnp
-    from jax import lax
-
     from mpi_tpu.models.rules import LIFE
     from mpi_tpu.ops.bitlife import init_packed
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+    from scan_common import measure_scan_popcount
 
     platform = jax.devices()[0].platform
     if platform != "tpu":
         raise RuntimeError(f"compile-wall experiment needs a TPU, got {platform!r}")
 
-    @jax.jit
-    def one(p):
-        out, _ = lax.scan(
-            lambda x, _: (
-                pallas_bit_step(x, LIFE, "periodic", gens=gens, blocks=(bm, cm)),
-                None,
-            ),
-            p, None, length=max(1, steps // gens),
-        )
-        return jnp.sum(lax.population_count(out).astype(jnp.uint32))
-
-    from scan_common import time_compiled
-
     grid = init_packed(h, nw * 32, seed=1)
-    eff_steps = max(1, steps // gens) * gens
-    compile_s, best = time_compiled(one, grid, h * nw * 32 * eff_steps)
+    passes = max(1, steps // gens)
+    compile_s, best = measure_scan_popcount(
+        lambda x: pallas_bit_step(x, LIFE, "periodic", gens=gens,
+                                  blocks=(bm, cm)),
+        grid, passes, h * nw * 32 * passes * gens,
+    )
     print(json.dumps({"compile_s": round(compile_s, 2),
                       "gcells_per_s": round(best / 1e9, 1)}))
 

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Measure every single-chip engine on hardware — the PERF.md ladder.
+
+One JSON row per engine at 16384² (Conway's Life, periodic), each child
+in its own subprocess (scan_common harness).  Step budgets scale with
+each engine's expected speed so every timed call runs multiple seconds
+(dispatch amortization, see PERF.md) without the slow engines taking
+tens of minutes.
+
+    python tools/engine_ladder.py --out perf/engine_ladder.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SIDE = 16384
+# (name, cell budget per timed call) — budget / SIDE^2 = steps.  The two
+# Pallas SWAR rows share the same 8e12 budget so their headline g1-vs-g8
+# comparison carries identical (sub-2%) dispatch overhead; the slower
+# engines get smaller budgets (their calls already run many seconds).
+ENGINES = (
+    ("dense-xla", 4e11),
+    ("dense-pallas", 8e11),
+    ("swar-xla", 2e12),
+    ("swar-pallas-g1", 8e12),
+    ("swar-pallas-g8", 8e12),
+)
+
+
+def child(name: str, budget: float) -> None:
+    import jax
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+
+    from mpi_tpu.models.rules import LIFE
+    from mpi_tpu.ops.bitlife import bit_step, init_packed
+    from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+    from mpi_tpu.ops.pallas_stencil import pallas_step
+    from mpi_tpu.ops.stencil import step as xla_step
+    from mpi_tpu.utils.hashinit import init_tile_jnp
+    from scan_common import measure_scan_popcount, steps_for_budget
+
+    if jax.devices()[0].platform != "tpu":
+        raise RuntimeError("engine ladder needs the real chip")
+
+    gens = 8 if name.endswith("g8") else 1
+    steps = steps_for_budget(budget, SIDE * SIDE, gens)
+    packed = name.startswith("swar")
+
+    if name == "dense-xla":
+        one = lambda g: xla_step(g, LIFE, "periodic")  # noqa: E731
+    elif name == "dense-pallas":
+        one = lambda g: pallas_step(g, LIFE, "periodic")  # noqa: E731
+    elif name == "swar-xla":
+        one = lambda g: bit_step(g, LIFE, "periodic")  # noqa: E731
+    else:
+        one = lambda g: pallas_bit_step(g, LIFE, "periodic", gens=gens)  # noqa: E731
+
+    grid = (init_packed(SIDE, SIDE, seed=1) if packed
+            else init_tile_jnp(SIDE, SIDE, seed=1))
+    compile_s, best = measure_scan_popcount(
+        one, grid, steps // gens, SIDE * SIDE * steps, packed=packed
+    )
+    print(json.dumps({
+        "engine": name, "side": SIDE, "steps": steps,
+        "gcells_per_s": round(best / 1e9, 1),
+        "compile_s": round(compile_s, 1),
+    }))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--out", default="perf/engine_ladder.json")
+    args = p.parse_args(argv)
+
+    from scan_common import require_tpu, run_child, write_out
+
+    if not require_tpu():
+        return 1
+
+    results = []
+    for name, budget in ENGINES:
+        res = run_child(__file__, (name, budget), args.timeout)
+        if "error" in res:
+            res = {"engine": name, **res}
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        write_out(args.out, results)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], float(sys.argv[3]))
+        sys.exit(0)
+    sys.exit(main())

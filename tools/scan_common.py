@@ -1,5 +1,5 @@
 """Shared harness for the TPU measurement scan tools (compile_wall,
-width_scan): probe-gated subprocess children with hard timeouts, guarded
+width_scan, engine_ladder): probe-gated subprocess children with hard timeouts, guarded
 stdout parsing, and incremental artifact writes — a hung or crashed
 config must cost one config, not the scan, and a partial run must leave
 its completed measurements on disk."""
@@ -51,6 +51,34 @@ def time_compiled(jitted, grid, cells_per_call):
         int(np.asarray(compiled(grid)))
         best = max(best, cells_per_call / (time.perf_counter() - t0))
     return compile_s, best
+
+
+def steps_for_budget(budget: float, cells_per_step: float, gens: int) -> int:
+    """Steps timing ~``budget`` cell-updates (dispatch amortization, see
+    PERF.md), at least one gens-pass, rounded down to a gens multiple."""
+    steps = max(gens, int(budget / cells_per_step))
+    return steps - steps % gens
+
+
+def measure_scan_popcount(one_pass, grid, passes: int, cells_per_call,
+                          packed: bool = True):
+    """The whole shared child protocol: build the scanned evolution with
+    a scalar population-count output (4-byte host fetch — the real
+    completion barrier; grids never cross the slow tunnel) and measure
+    it with :func:`time_compiled`.  Returns ``(compile_s, cells/s)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def evolve_pop(g):
+        out, _ = lax.scan(lambda x, _: (one_pass(x), None), g, None,
+                          length=passes)
+        if packed:
+            return jnp.sum(lax.population_count(out).astype(jnp.uint32))
+        return jnp.sum(out.astype(jnp.uint32))
+
+    return time_compiled(evolve_pop, grid, cells_per_call)
 
 
 def write_out(path: str, results) -> None:

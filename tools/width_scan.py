@@ -31,8 +31,6 @@ SHAPES = (
 
 def child(H: int, NW: int, gens: int) -> None:
     import jax
-    import jax.numpy as jnp
-    from jax import lax
 
     from mpi_tpu.utils.platform import apply_platform_override
 
@@ -40,24 +38,17 @@ def child(H: int, NW: int, gens: int) -> None:
     from mpi_tpu.models.rules import LIFE
     from mpi_tpu.ops.bitlife import init_packed
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, _pick_blocks
+    from scan_common import measure_scan_popcount, steps_for_budget
 
     if jax.devices()[0].platform != "tpu":
         raise RuntimeError("width scan needs the real chip")
-    steps = max(gens, int(8e12 / (H * NW * 32)))
-    steps -= steps % gens
-
-    @jax.jit
-    def evolve_pop(p):
-        out, _ = lax.scan(
-            lambda x, _: (pallas_bit_step(x, LIFE, "periodic", gens=gens), None),
-            p, None, length=steps // gens,
-        )
-        return jnp.sum(lax.population_count(out).astype(jnp.uint32))
-
-    from scan_common import time_compiled
+    steps = steps_for_budget(8e12, H * NW * 32, gens)
 
     grid = init_packed(H, NW * 32, seed=1)
-    compile_s, best = time_compiled(evolve_pop, grid, H * NW * 32 * steps)
+    compile_s, best = measure_scan_popcount(
+        lambda x: pallas_bit_step(x, LIFE, "periodic", gens=gens),
+        grid, steps // gens, H * NW * 32 * steps,
+    )
     print(json.dumps({
         "H": H, "NW": NW, "gens": gens,
         "blocks": list(_pick_blocks(H, NW, gens) or ()),

@@ -91,6 +91,22 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
     )
 
 
+def _ltl_single_device(config: GolConfig) -> bool:
+    """Serve a radius > 1 rule with the fused bit-sliced LtL kernel
+    (ops/pallas_bitltl.py)?  Single-device, comm_every == 1 (the kernel
+    has no temporal blocking), packable width, and the same TPU gating
+    as the other Pallas dispatches.  Measured (PERF.md): 124 Gcell/s for
+    Bosco vs 34 for the best dense engine."""
+    if config.comm_every != 1:
+        return False
+    from mpi_tpu.ops.pallas_bitltl import supports
+
+    if not supports((config.rows, config.cols), config.rule):
+        return False
+    use, _ = _pallas_single_device_mode()
+    return use
+
+
 def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int):
     """Dense-engine stepper: on a single device the fused dense Pallas
     kernel (ops/pallas_stencil.py, one HBM read + one write per cell per
@@ -183,6 +199,10 @@ def run_tpu(
     from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
 
     packed_mode = config.rule.radius == 1 and (config.cols // mj) % WORD == 0
+    # radius > 1 on one device: the packed bit-sliced LtL kernel replaces
+    # the dense path when it applies (same packed init/snapshot plumbing)
+    ltl_mode = (not packed_mode and mi * mj == 1
+                and _ltl_single_device(config))
     if config.overlap and mi * mj > 1:
         # fail fast instead of silently running without the requested
         # overlap: tiles must be big enough for the stitched edge bands
@@ -203,12 +223,20 @@ def run_tpu(
                     f"{config.rule.radius} x comm_every {config.comm_every} "
                     f"bands (got {tile_r}x{tile_c})"
                 )
-    if packed_mode:
+    if packed_mode or ltl_mode:
         from mpi_tpu.parallel.step import (
             sharded_bit_init, make_sharded_unpacker,
         )
 
-        evolve = _pick_packed_evolve(config, mesh, mi * mj)
+        if ltl_mode:
+            from mpi_tpu.ops.pallas_bitltl import make_pallas_ltl_stepper
+
+            _, interpret = _pallas_single_device_mode()
+            evolve = make_pallas_ltl_stepper(
+                config.rule, config.boundary, interpret=interpret
+            )
+        else:
+            evolve = _pick_packed_evolve(config, mesh, mi * mj)
         if initial is not None:
             grid = _put_initial(mesh, initial, config.rows, config.cols, True)
         else:
@@ -237,7 +265,8 @@ def run_tpu(
     force_fetch(grid)
     timer.setup_done()
 
-    unpacker = make_sharded_unpacker(mesh) if packed_mode and want_snapshots else None
+    unpacker = (make_sharded_unpacker(mesh)
+                if (packed_mode or ltl_mode) and want_snapshots else None)
 
     def tiles_of(g):
         return _shard_tiles(unpacker(g) if unpacker is not None else g)
@@ -259,7 +288,7 @@ def run_tpu(
         # shards (snapshots already wrote them) — no host-side global grid
         return None
     final = np.asarray(jax.device_get(grid))
-    return unpack_np(final) if packed_mode else final
+    return unpack_np(final) if packed_mode or ltl_mode else final
 
 
 def device_count() -> int:

@@ -1,0 +1,242 @@
+"""Bit-sliced SWAR engine for radius-r (Larger-than-Life) rules.
+
+The radius-1 engine (``ops/bitlife.py``) exploits that an 8-neighbor
+count fits the ``s0 + 2k`` symmetric-function trick; at radius r the
+count runs to ``(2r+1)² − 1`` (120 for Bosco's r=5), so that trick dies
+— and the dense uint8 path that serves those rules spends ~(2r+1)²
+vector ops per *cell* (measured 88 Gcell/s for radius 1, far less at
+radius 5).  This engine keeps the 32-cells-per-uint32-lane packing and
+represents every per-cell integer as a list of uint32 *bit planes*
+(plane k holds bit k of each cell's value, LSB first):
+
+* **vertical sums** — a ripple carry-save accumulation of the 2r+1
+  vertically shifted row words gives each column's (2r+1)-cell sum as a
+  ≤4-plane bit-sliced number;
+* **horizontal sums** — each plane is shifted d = −r..r bits with
+  cross-word carries from the adjacent words (one prev/next roll per
+  plane, reused across all d), and the 2r+1 shifted column sums are
+  ripple-added into the ≤8-plane bit-sliced neighborhood total;
+* **rule application** — the total *includes* the center cell, so
+  instead of a bit-sliced subtraction the survive intervals are tested
+  shifted by +1 (alive ⇒ total = count + 1); birth/survive interval
+  membership is an MSB-first bit-sliced comparator (~2 ops per plane
+  per threshold), and the next state is
+  ``(dead & born) | (alive & survives)``.
+
+Cost for Bosco (r=5): ~250 uint32 ops per 32-cell word ≈ 8 ops/cell —
+an order of magnitude under the dense path's ~120 ops/cell, with 8×
+less HBM traffic.  Everything is elementwise jnp on the packed (H,
+W/32) uint32 layout shared with ``bitlife``, so XLA fuses the step and
+the identical code runs under ``lax.scan`` and inside ``shard_map``.
+
+Reference parity anchor: this replaces the generalized form of the
+``next()`` neighbor sweep (``/root/reference/main.cpp:79-90``) for
+radius > 1; the numpy oracle (``backends/serial_np.py``) remains the
+bit-exactness pin.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mpi_tpu.models.rules import Rule
+from mpi_tpu.ops.bitlife import WORD
+
+Plane = Optional[jax.Array]  # None encodes the constant-0 plane
+
+
+def _and(a: Plane, b: Plane) -> Plane:
+    if a is None or b is None:
+        return None
+    return a & b
+
+
+def _xor(a: Plane, b: Plane) -> Plane:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a ^ b
+
+
+def _or(a: Plane, b: Plane) -> Plane:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+def bs_add(a: List[Plane], b: List[Plane]) -> List[Plane]:
+    """Ripple add two bit-sliced numbers (LSB-first plane lists)."""
+    out: List[Plane] = []
+    carry: Plane = None
+    for i in range(max(len(a), len(b))):
+        x = a[i] if i < len(a) else None
+        y = b[i] if i < len(b) else None
+        s = _xor(_xor(x, y), carry)
+        carry = _or(_or(_and(x, y), _and(x, carry)), _and(y, carry))
+        out.append(s)
+    if carry is not None:
+        out.append(carry)
+    return out
+
+
+def bs_ge(planes: List[Plane], t: int, zero: jax.Array) -> jax.Array:
+    """Mask of cells whose bit-sliced value is >= the constant ``t``.
+
+    ``zero`` is a concrete all-zeros word array used to realize constant
+    planes when a comparison needs them."""
+    if t <= 0:
+        return ~zero  # all ones
+    if t >= (1 << len(planes)):
+        return zero  # value can never reach t
+    # NOTE two distinct None conventions here: a None *plane* is the
+    # constant-0 plane (as everywhere in this module), while eq=None
+    # means "all cells still equal" (constant-1 mask) — so eq is
+    # narrowed with an explicit helper, never with _and.
+    gt: Plane = None  # strictly greater, decided at a higher plane
+    eq: Plane = None  # still equal so far (None = all cells equal)
+
+    def narrow(eq_mask, m):
+        return m if eq_mask is None else (eq_mask & m)
+
+    for k in reversed(range(len(planes))):
+        p = planes[k]
+        tb = (t >> k) & 1
+        if tb == 0:
+            if p is not None:
+                # value bit 1 where t bit 0 → greater (if equal above)
+                gt = _or(gt, narrow(eq, p))
+                # equality continues where the value bit is 0
+                eq = narrow(eq, ~p)
+            # p None: value bit 0 == t bit 0 → eq unchanged, no gt
+        else:
+            if p is None:
+                # value bit 0 < t bit 1 → equality impossible below here
+                return gt if gt is not None else zero
+            # equality continues only where the value bit is 1
+            eq = narrow(eq, p)
+    eq_mask = ~zero if eq is None else eq
+    return eq_mask if gt is None else (gt | eq_mask)
+
+
+def _in_intervals(planes: List[Plane], intervals, shift: int,
+                  zero: jax.Array) -> jax.Array:
+    """OR of inclusive-interval tests ``lo+shift <= value <= hi+shift``."""
+    acc = zero
+    for lo, hi in intervals:
+        # bs_ge returns the zero mask for unreachable thresholds, so the
+        # upper test degenerates gracefully for intervals past max_count
+        m = bs_ge(planes, lo + shift, zero) \
+            & ~bs_ge(planes, hi + shift + 1, zero)
+        acc = acc | m
+    return acc
+
+
+def make_hshift(v: List[Plane], word_roll):
+    """Horizontal bit-shift family over bit-sliced planes ``v``.
+
+    Returns ``hshift(k)`` producing v shifted so bit j sees column j+k
+    (|k| < 32), with cross-word bits supplied by ``word_roll(plane,
+    ±1)`` — computed once here and reused across all shift distances.
+    Shared by the XLA path (jnp.roll words) and the Pallas kernel
+    (pltpu.roll lanes): LSB = lowest column index, so "column j+k" is a
+    right bit-shift fed from the next word."""
+    prev = [None if p is None else word_roll(p, 1) for p in v]
+    nxt = [None if p is None else word_roll(p, -1) for p in v]
+
+    def hshift(k: int) -> List[Plane]:
+        if k == 0:
+            return list(v)
+        sh = jnp.uint32(abs(k))
+        inv = jnp.uint32(WORD - abs(k))
+        out: List[Plane] = []
+        for p, pw, nw_ in zip(v, prev, nxt):
+            if p is None:
+                out.append(None)
+            elif k > 0:
+                out.append((p >> sh) | (nw_ << inv))
+            else:
+                out.append((p << sh) | (pw >> inv))
+        return out
+
+    return hshift
+
+
+def supports(shape: Tuple[int, int], rule: Rule) -> bool:
+    """Packed-width shapes this engine serves (any radius the rule
+    system allows; radius-1 rules should prefer ``bitlife``)."""
+    H, W = shape
+    return W % WORD == 0 and H >= 2 * rule.radius + 1 and rule.radius <= 7
+
+
+def _vshift(x: jax.Array, d: int, periodic: bool) -> jax.Array:
+    """Rows shifted so row i sees row i+d; dead boundary shifts in 0."""
+    rolled = jnp.roll(x, -d, axis=0)
+    if periodic:
+        return rolled
+    H = x.shape[0]
+    idx = jnp.arange(H, dtype=jnp.int32)[:, None]
+    valid = (idx + d >= 0) & (idx + d < H)
+    return jnp.where(valid, rolled, jnp.uint32(0))
+
+
+def ltl_step(packed: jax.Array, rule: Rule,
+             boundary: str = "periodic") -> jax.Array:
+    """One generation of a radius-r outer-totalistic rule on a packed
+    (H, W/32) uint32 grid."""
+    H, NW = packed.shape
+    r = rule.radius
+    periodic = boundary == "periodic"
+    zero = jnp.zeros_like(packed)
+    mid = packed
+
+    # 1. vertical (column) sums: bit-sliced sum of the 2r+1 row words
+    v: List[Plane] = [mid]
+    for d in range(1, r + 1):
+        v = bs_add(v, [_vshift(mid, d, periodic)])
+        v = bs_add(v, [_vshift(mid, -d, periodic)])
+
+    # 2. horizontal sums over the bit-sliced planes (see make_hshift)
+    def word_roll(x, d):
+        rolled = jnp.roll(x, d, axis=1)
+        if periodic:
+            return rolled
+        col = jnp.arange(NW, dtype=jnp.int32)[None, :]
+        valid = (col - d >= 0) & (col - d < NW)
+        return jnp.where(valid, rolled, jnp.uint32(0))
+
+    hshift = make_hshift(v, word_roll)
+
+    total: List[Plane] = list(v)
+    for d in range(1, r + 1):
+        total = bs_add(total, hshift(d))
+        total = bs_add(total, hshift(-d))
+
+    # 3. rule application; total includes the center cell, so survive
+    # intervals are tested shifted by +1 (alive ⇒ total = count + 1)
+    born = _in_intervals(total, rule.birth_intervals, 0, zero)
+    stay = _in_intervals(total, rule.survive_intervals, 1, zero)
+    return (~mid & born) | (mid & stay)
+
+
+def make_ltl_stepper(rule: Rule, boundary: str = "periodic"):
+    """evolve(packed, steps) — jitted scan with donated carry, mirroring
+    ``bitlife.make_bit_stepper``'s contract (lowerable for AOT)."""
+    import functools
+
+    from jax import lax
+
+    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=(0,))
+    def evolve(packed, steps: int):
+        out, _ = lax.scan(
+            lambda g, _: (ltl_step(g, rule, boundary), None),
+            packed, None, length=steps,
+        )
+        return out
+
+    return evolve

@@ -1,0 +1,235 @@
+"""Fused Pallas kernel for the bit-sliced radius-r (LtL) engine.
+
+``ops/bitltl.py``'s XLA path materializes every bit plane in HBM (the
+rolls defeat fusion): measured 73 Gcell/s for Bosco at 4096² but 13 at
+16384² — bandwidth-bound.  This kernel streams row slabs through VMEM
+with the same double-buffered halo-slab DMA scaffold as
+``ops/pallas_bitlife.py`` (the 8-row DMA-alignment halo happens to
+cover every radius the rule system allows, r ≤ 7), so each step costs
+one packed HBM read + one packed write and the plane arithmetic runs
+out of VMEM:
+
+* vertical sums are *slab row slices* at static offsets — free, where
+  the XLA path paid a materialized roll per shift;
+* horizontal cross-word bits come from ``pltpu.roll`` lane rotation of
+  each plane (one prev/next rotation per plane, reused across shift
+  distances), exactly the ``bitlife`` convention;
+* the per-generation compute is ``bitltl``'s shared plane arithmetic
+  (``bs_add`` ripple adders, ``bs_ge`` comparators, +1-shifted survive
+  intervals) applied to CM-row sub-tiles to bound live VMEM.
+
+No temporal blocking: gens=1 per pass (the radius-r dependence cone
+consumes r rows per side per generation, so the 8-row halo would allow
+only ⌊8/r⌋ generations — not worth the trapezoid complexity while the
+kernel is already compute-bound).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_tpu.models.rules import Rule
+from mpi_tpu.ops.bitlife import WORD
+from mpi_tpu.ops.bitltl import Plane, bs_add, make_hshift, _in_intervals
+
+HALO = 8  # DMA row slices must be 8-sublane aligned; covers r <= 7
+
+
+def _nplanes(radius: int) -> int:
+    """Bit planes needed for the neighborhood total (2r+1)²."""
+    total = (2 * radius + 1) ** 2
+    return max(1, total.bit_length())
+
+
+def _pick_blocks(H: int, NW: int, radius: int) -> Tuple[int, int] | None:
+    """(BM, CM) slab/compute-tile rows.  The live working set is the
+    double-buffered slab plus ~11 (CM, NW) u32 temporaries *per bit
+    plane* of the neighborhood total (the v/prev/next/shifted/total
+    plane families plus comparator masks all scale with the plane
+    count) — calibrated on hardware 2026-07-30: Mosaic reported 20.33M
+    for (BM=256, CM=256, NW=256, r=5), i.e. ~75 per sub-tile row ≈ 10.7
+    per plane at r=5's 7 planes; 11 is the safety-rounded coefficient."""
+    limit = int(15.25 * (1 << 20))
+    coeff = 11 * _nplanes(radius)
+    for bm in (512, 256, 128, 64, 32, 16, 8):
+        if H % bm:
+            continue
+        dbuf = 2 * (bm + 2 * HALO) * NW * 4
+        for cm in (256, 128, 64, 32, 16, 8):
+            if cm > bm:
+                continue
+            temps = coeff * (cm + 2) * NW * 4
+            if dbuf + temps <= limit:
+                return bm, cm
+    return None
+
+
+def supports(shape: Tuple[int, int], rule: Rule) -> bool:
+    H, W = shape
+    return (
+        W % WORD == 0
+        and (W // WORD) % 128 == 0  # packed width must stay lane-aligned
+        and 1 <= rule.radius <= 7
+        and H >= HALO
+        and _pick_blocks(H, W // WORD, rule.radius) is not None
+    )
+
+
+def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int):
+    periodic = boundary == "periodic"
+    r = rule.radius
+    nblocks = H // BM
+
+    def _block_dmas(in_hbm, dbuf, sems, blk, slot):
+        base = blk * BM
+        top = pl.multiple_of(lax.rem(base - HALO + H, H), HALO)
+        bot = pl.multiple_of(lax.rem(base + BM, H), HALO)
+        return (
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(top, HALO), :],
+                dbuf.at[slot, pl.ds(0, HALO), :],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(base, BM), :],
+                dbuf.at[slot, pl.ds(HALO, BM), :],
+                sems.at[slot, 1],
+            ),
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(bot, HALO), :],
+                dbuf.at[slot, pl.ds(HALO + BM, HALO), :],
+                sems.at[slot, 2],
+            ),
+        )
+
+    def kernel(in_hbm, out_ref, dbuf, sems):
+        i = pl.program_id(0)
+        slot = lax.rem(i, 2)
+        next_slot = lax.rem(i + 1, 2)
+
+        @pl.when(i == 0)
+        def _():
+            for d in _block_dmas(in_hbm, dbuf, sems, 0, 0):
+                d.start()
+
+        @pl.when(i + 1 < nblocks)
+        def _():
+            for d in _block_dmas(in_hbm, dbuf, sems, i + 1, next_slot):
+                d.start()
+
+        for d in _block_dmas(in_hbm, dbuf, sems, i, slot):
+            d.wait()
+
+        scratch = dbuf.at[slot]
+
+        if not periodic:
+            # rows beyond the grid are dead cells
+            @pl.when(i == 0)
+            def _():
+                scratch[0:HALO, :] = jnp.zeros((HALO, NW), dtype=jnp.uint32)
+
+            @pl.when(i == nblocks - 1)
+            def _():
+                scratch[HALO + BM : HALO + BM + HALO, :] = jnp.zeros(
+                    (HALO, NW), dtype=jnp.uint32
+                )
+
+        def compute_rows(a: int, rows: int):
+            """Next state of slab rows [a, a+rows) (absolute slab idx)."""
+            # vertical sums: free static slab slices, one 1-bit ripple
+            # add per neighbor row
+            v: List[Plane] = [scratch[a : a + rows, :]]
+            for d in range(1, r + 1):
+                v = bs_add(v, [scratch[a + d : a + rows + d, :]])
+                v = bs_add(v, [scratch[a - d : a + rows - d, :]])
+
+            lane = (
+                None if periodic
+                else lax.broadcasted_iota(jnp.int32, (rows, NW), dimension=1)
+            )
+
+            def word_roll(x, d):
+                rolled = pltpu.roll(x, d % NW, axis=1)
+                if periodic:
+                    return rolled
+                # dead boundary: words rolled across the grid edge are 0
+                valid = (lane - d >= 0) & (lane - d < NW)
+                return jnp.where(valid, rolled, jnp.uint32(0))
+
+            hshift = make_hshift(v, word_roll)
+
+            total: List[Plane] = list(v)
+            for d in range(1, r + 1):
+                total = bs_add(total, hshift(d))
+                total = bs_add(total, hshift(-d))
+
+            mid = scratch[a : a + rows, :]
+            zero = jnp.zeros((rows, NW), dtype=jnp.uint32)
+            born = _in_intervals(total, rule.birth_intervals, 0, zero)
+            stay = _in_intervals(total, rule.survive_intervals, 1, zero)
+            out_ref[a - HALO : a + rows - HALO, :] = (~mid & born) | (mid & stay)
+
+        a = HALO
+        while a < HALO + BM:
+            rows = min(CM, HALO + BM - a)
+            compute_rows(a, rows)
+            a += rows
+
+    return kernel
+
+
+def pallas_ltl_step(
+    packed: jax.Array,
+    rule: Rule,
+    boundary: str = "periodic",
+    interpret: bool = False,
+    blocks: Tuple[int, int] | None = None,
+) -> jax.Array:
+    """One radius-r generation on a packed (H, W/32) uint32 grid via the
+    fused bit-sliced kernel.  Requires ``supports((H, W), rule)``."""
+    H, NW = packed.shape
+    picked = blocks or _pick_blocks(H, NW, rule.radius)
+    if picked is None or rule.radius > 7:
+        raise ValueError(
+            f"pallas_ltl_step cannot handle packed shape {packed.shape}"
+        )
+    BM, CM = picked
+    kernel = _make_kernel(rule, boundary, H, NW, BM, CM)
+    return pl.pallas_call(
+        kernel,
+        grid=(H // BM,),
+        out_shape=jax.ShapeDtypeStruct((H, NW), jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((BM, NW), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, BM + 2 * HALO, NW), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=interpret,
+    )(packed)
+
+
+def make_pallas_ltl_stepper(
+    rule: Rule, boundary: str = "periodic", interpret: bool = False
+):
+    """evolve(packed, steps) — jitted scan with donated carry."""
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=(0,))
+    def evolve(packed, steps: int):
+        out, _ = lax.scan(
+            lambda g, _: (
+                pallas_ltl_step(g, rule, boundary, interpret=interpret),
+                None,
+            ),
+            packed, None, length=steps,
+        )
+        return out
+
+    return evolve

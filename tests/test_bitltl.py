@@ -1,0 +1,138 @@
+"""Bit-sliced radius-r (LtL) engine: plane arithmetic units, XLA and
+fused-Pallas parity vs the numpy oracle, and the run_tpu dispatch."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mpi_tpu.models.rules import BOSCO, LIFE, Rule, rule_from_name
+from mpi_tpu.ops.bitlife import pack_np, unpack_np
+from mpi_tpu.ops.bitltl import bs_add, bs_ge, ltl_step
+from mpi_tpu.ops.bitltl import supports as xla_supports
+from mpi_tpu.ops.pallas_bitltl import (
+    _nplanes,
+    _pick_blocks,
+    pallas_ltl_step,
+    supports,
+)
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.utils.hashinit import init_tile_np
+
+R2 = rule_from_name("R2,B10-13,S8-12")
+R3 = rule_from_name("R3,B20-25,S18-30")
+R7 = Rule("r7", frozenset(range(80, 101)), frozenset(range(75, 120)), radius=7)
+
+
+def test_bs_add_and_ge_against_ints():
+    # encode two vectors of small ints as bit planes, add, compare —
+    # results must match plain integer arithmetic bit-for-bit
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, 120, size=64, dtype=np.uint32)
+    b = rng.integers(0, 120, size=64, dtype=np.uint32)
+
+    # pack each int's bits across 64 one-bit "cells" (words with 1 live bit)
+    ap = [jnp.asarray((((a >> k) & 1)).astype(np.uint32)).reshape(1, 64)
+          for k in range(7)]
+    bp = [jnp.asarray((((b >> k) & 1)).astype(np.uint32)).reshape(1, 64)
+          for k in range(7)]
+    s = bs_add(ap, bp)
+    got = sum((np.asarray(p).astype(np.uint64) << k) for k, p in enumerate(s))
+    np.testing.assert_array_equal(got.ravel(), (a + b).astype(np.uint64))
+
+    zero = jnp.zeros((1, 64), dtype=jnp.uint32)
+    for t in (0, 1, 63, 120, 200, 255, 256, 300):
+        m = np.asarray(bs_ge(s, t, zero)).ravel()
+        np.testing.assert_array_equal(m != 0, (a + b) >= t,
+                                      err_msg=f"t={t}")
+
+
+@pytest.mark.parametrize("rule", [BOSCO, R2, R3, R7, LIFE],
+                         ids=lambda r: r.name)
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_ltl_step_xla_matches_oracle(rule, boundary):
+    g = init_tile_np(64, 128, seed=3)
+    p = jnp.asarray(pack_np(g))
+    for _ in range(4):
+        p = ltl_step(p, rule, boundary)
+    np.testing.assert_array_equal(
+        unpack_np(np.asarray(p)), evolve_np(g, 4, rule, boundary)
+    )
+
+
+@pytest.mark.parametrize("rule", [BOSCO, R2], ids=lambda r: r.name)
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_pallas_ltl_matches_oracle(rule, boundary):
+    # forced small blocks exercise block boundaries and row sub-tiling
+    g = init_tile_np(64, 4096, seed=3)
+    p = jnp.asarray(pack_np(g))
+    for _ in range(3):
+        p = pallas_ltl_step(p, rule, boundary, interpret=True, blocks=(16, 8))
+    np.testing.assert_array_equal(
+        unpack_np(np.asarray(p)), evolve_np(g, 3, rule, boundary)
+    )
+
+
+def test_supports_and_blocks():
+    assert supports((4096, 4096), BOSCO)
+    assert not supports((4096, 4096 + 32), BOSCO)  # not lane-aligned
+    assert not supports((4096, 100), BOSCO)  # not word-aligned
+    assert xla_supports((64, 128), R7)
+    # the VMEM model must hold for the calibrated coefficient (Mosaic
+    # reported ~75/row at r=5's 7 planes; see _pick_blocks docstring)
+    for nw, r in ((128, 2), (512, 5), (2048, 5), (512, 7)):
+        picked = _pick_blocks(65536, nw, r)
+        assert picked is not None
+        bm, cm = picked
+        need = (2 * (bm + 16) * nw * 4
+                + 11 * _nplanes(r) * (cm + 2) * nw * 4)
+        assert need <= 15.25 * (1 << 20)
+    # the hardware-rejected shape must stay rejected: (256, 256) at
+    # NW=256, r=5 measured 20.33M over the 16M limit
+    bm, cm = _pick_blocks(256, 256, 5)
+    assert (bm, cm) != (256, 256)
+
+
+def test_run_tpu_dispatches_fused_ltl_kernel(monkeypatch):
+    # single device + radius-2 rule + lane-aligned packable width →
+    # run_tpu must take the packed bit-sliced kernel, not the dense path
+    import mpi_tpu.ops.pallas_bitltl as pbl
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    calls = []
+    real = pbl.pallas_ltl_step
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(pbl, "pallas_ltl_step", spy)
+    cfg = GolConfig(rows=32, cols=4096, steps=2, seed=5, rule=R2,
+                    mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    assert calls, "radius-2 single-device run must use the fused LtL kernel"
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 4096, seed=5), 2, R2, "periodic")
+    )
+
+
+def test_run_tpu_ltl_off_tpu_keeps_dense_path(monkeypatch):
+    # without the interpret opt-in the production off-TPU path must keep
+    # the compiled dense stepper (interpret Pallas is too slow)
+    import mpi_tpu.ops.pallas_bitltl as pbl
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    monkeypatch.delenv("MPI_TPU_PALLAS_INTERPRET", raising=False)
+
+    def boom(*a, **k):
+        raise AssertionError("LtL kernel must not run off-TPU by default")
+
+    monkeypatch.setattr(pbl, "pallas_ltl_step", boom)
+    cfg = GolConfig(rows=32, cols=4096, steps=2, seed=5, rule=R2,
+                    mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 4096, seed=5), 2, R2, "periodic")
+    )

@@ -28,6 +28,11 @@ ENGINES = (
     ("swar-xla", 2e12),
     ("swar-pallas-g1", 8e12),
     ("swar-pallas-g8", 8e12),
+    # radius-5 (Bosco) rows: the dense engines vs the bit-sliced engine,
+    # XLA path included to pin its HBM-bound collapse at this size
+    ("bosco-dense-pallas", 2e11),
+    ("bosco-bitsliced-xla", 2e11),
+    ("bosco-bitsliced-pallas", 8e11),
 )
 
 
@@ -38,9 +43,11 @@ def child(name: str, budget: float) -> None:
 
     apply_platform_override()
 
-    from mpi_tpu.models.rules import LIFE
+    from mpi_tpu.models.rules import BOSCO, LIFE
     from mpi_tpu.ops.bitlife import bit_step, init_packed
+    from mpi_tpu.ops.bitltl import ltl_step
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+    from mpi_tpu.ops.pallas_bitltl import pallas_ltl_step
     from mpi_tpu.ops.pallas_stencil import pallas_step
     from mpi_tpu.ops.stencil import step as xla_step
     from mpi_tpu.utils.hashinit import init_tile_jnp
@@ -51,7 +58,7 @@ def child(name: str, budget: float) -> None:
 
     gens = 8 if name.endswith("g8") else 1
     steps = steps_for_budget(budget, SIDE * SIDE, gens)
-    packed = name.startswith("swar")
+    packed = name.startswith("swar") or "bitsliced" in name
 
     if name == "dense-xla":
         one = lambda g: xla_step(g, LIFE, "periodic")  # noqa: E731
@@ -59,6 +66,12 @@ def child(name: str, budget: float) -> None:
         one = lambda g: pallas_step(g, LIFE, "periodic")  # noqa: E731
     elif name == "swar-xla":
         one = lambda g: bit_step(g, LIFE, "periodic")  # noqa: E731
+    elif name == "bosco-dense-pallas":
+        one = lambda g: pallas_step(g, BOSCO, "periodic")  # noqa: E731
+    elif name == "bosco-bitsliced-pallas":
+        one = lambda g: pallas_ltl_step(g, BOSCO, "periodic")  # noqa: E731
+    elif name == "bosco-bitsliced-xla":
+        one = lambda g: ltl_step(g, BOSCO, "periodic")  # noqa: E731
     else:
         one = lambda g: pallas_bit_step(g, LIFE, "periodic", gens=gens)  # noqa: E731
 

@@ -199,10 +199,26 @@ def run_tpu(
     from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
 
     packed_mode = config.rule.radius == 1 and (config.cols // mj) % WORD == 0
-    # radius > 1 on one device: the packed bit-sliced LtL kernel replaces
-    # the dense path when it applies (same packed init/snapshot plumbing)
-    ltl_mode = (not packed_mode and mi * mj == 1
-                and _ltl_single_device(config))
+    # radius > 1: the packed bit-sliced LtL engine replaces the dense path
+    # when it applies (same packed init/snapshot plumbing) — the fused
+    # Pallas kernel on one device, the shard_map/ppermute XLA stepper on
+    # meshes (overlap stays with the dense stepper, which implements it)
+    ltl_mode = None
+    if not packed_mode and config.rule.radius > 1 \
+            and (config.cols // mj) % WORD == 0:
+        if mi * mj == 1 and _ltl_single_device(config):
+            ltl_mode = "pallas"
+        elif config.comm_every * config.rule.radius <= 31 and (
+            (mi * mj > 1 and not config.overlap)
+            # single device + comm_every > 1: the fused kernel has no
+            # temporal blocking, but the sharded stepper on a 1x1 mesh
+            # (self-wrapping exchange) still beats dense on TPU-class
+            # tiles; off-TPU production keeps dense (measured slower on
+            # CPU at radius 5)
+            or (mi * mj == 1 and config.comm_every > 1
+                and _pallas_single_device_mode()[0])
+        ):
+            ltl_mode = "sharded"
     if config.overlap and mi * mj > 1:
         # fail fast instead of silently running without the requested
         # overlap: tiles must be big enough for the stitched edge bands
@@ -228,12 +244,19 @@ def run_tpu(
             sharded_bit_init, make_sharded_unpacker,
         )
 
-        if ltl_mode:
+        if ltl_mode == "pallas":
             from mpi_tpu.ops.pallas_bitltl import make_pallas_ltl_stepper
 
             _, interpret = _pallas_single_device_mode()
             evolve = make_pallas_ltl_stepper(
                 config.rule, config.boundary, interpret=interpret
+            )
+        elif ltl_mode == "sharded":
+            from mpi_tpu.parallel.step import make_sharded_ltl_stepper
+
+            evolve = make_sharded_ltl_stepper(
+                mesh, config.rule, config.boundary,
+                gens_per_exchange=config.comm_every,
             )
         else:
             evolve = _pick_packed_evolve(config, mesh, mi * mj)

@@ -278,6 +278,57 @@ def make_sharded_bit_stepper(
     return segmented_evolve(make_local, K)
 
 
+def make_sharded_ltl_stepper(
+    mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1,
+):
+    """Bit-sliced radius-r shard-parallel evolution: packed (rows,
+    cols/32) uint32 grids, the LtL generalization of
+    ``make_sharded_bit_stepper``.  One exchange ships K·r ghost rows and
+    a single ghost word column (32 halo bits cover K·r ≤ 31), then
+    ``ops.bitltl.ltl_step`` runs K generations on the padded tile with
+    its *dead* (zero-fill) tile-edge semantics — correct regardless of
+    the global boundary, because the cropped interior's dependence cone
+    only ever touches ghost data, and every cell the zero fill can reach
+    is cropped.  Dead global boundary: the ghost fringe is re-killed on
+    mesh-edge shards after every generation so ghost-space "births"
+    never feed back (same discipline as the radius-1 stepper)."""
+    from mpi_tpu.ops.bitltl import ltl_step
+    from mpi_tpu.parallel.halo import exchange_halo_rc
+
+    K = gens_per_exchange
+    r = rule.radius
+    if K < 1 or K * r > 31:
+        raise ValueError(
+            f"gens_per_exchange must satisfy 1 <= K and K*r <= 31 "
+            f"(one ghost word column), got K={K}, r={r}"
+        )
+    if K > 1 and 0 in rule.birth:
+        raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
+    spec = PartitionSpec(*axes)
+    periodic = boundary == "periodic"
+
+    def make_local(k):
+        d = k * r
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+        def local_step(local):
+            p = exchange_halo_rc(local, d, 1, boundary, axes)
+            for g in range(k):
+                p = ltl_step(p, rule, "dead")
+                if not periodic and g < k - 1:
+                    # every ghost row / ghost word column on a mesh-edge
+                    # shard lies outside the global grid — dead cells by
+                    # definition, re-killed between generations so ghost
+                    # "births" never feed back (the final generation's
+                    # ghosts are cropped, no kill needed)
+                    p = _kill_outside_global(p, axes, (d, d, 1, 1))
+            return p[d:-d, 1:-1]
+
+        return local_step
+
+    return segmented_evolve(make_local, K)
+
+
 def sharded_bit_init(mesh: Mesh, rows: int, cols: int, seed: int, axes=AXES):
     """Initialize the packed grid on-device, each shard hashing and packing
     its own global coordinates blockwise (no giant intermediates)."""

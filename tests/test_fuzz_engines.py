@@ -92,3 +92,23 @@ def test_fuzz_packed_matches_oracle():
                 evolve_cpp(g, steps, rule, boundary), ref)
         finally:
             del os.environ["GOLCORE_SWAR_BLOCK_THRESHOLD"]
+
+
+@pytest.mark.parametrize("case", CASES[:6])
+def test_fuzz_bitltl_padded_widths(case):
+    # random widths essentially never land on multiples of 32: re-run
+    # each case at the next word-aligned width so the packed bit-sliced
+    # radius-r engine fuzzes against the oracle too
+    import jax.numpy as jnp
+
+    from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
+    from mpi_tpu.ops.bitltl import ltl_step
+
+    rule, rows, cols, seed, steps, boundary = case
+    cols = ((cols + WORD - 1) // WORD) * WORD
+    g = init_tile_np(rows, cols, seed=seed)
+    p = jnp.asarray(pack_np(g))
+    for _ in range(steps):
+        p = ltl_step(p, rule, boundary)
+    np.testing.assert_array_equal(
+        unpack_np(np.asarray(p)), evolve_np(g, steps, rule, boundary))

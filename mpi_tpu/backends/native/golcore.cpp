@@ -197,14 +197,11 @@ void swar_gen_rows(const uint64_t* cur, uint64_t* nxt, int64_t nw,
     }
 }
 
+static void ltl_fill_ghost_rows(uint64_t* buf, int64_t rows, int64_t nw,
+                                int r, bool periodic);
+
 void swar_fill_ghost_rows(uint64_t* buf, int64_t rows, int64_t nw, bool periodic) {
-    if (periodic) {
-        std::memcpy(buf, buf + rows * nw, (size_t)nw * 8);
-        std::memcpy(buf + (rows + 1) * nw, buf + nw, (size_t)nw * 8);
-    } else {
-        std::memset(buf, 0, (size_t)nw * 8);
-        std::memset(buf + (rows + 1) * nw, 0, (size_t)nw * 8);
-    }
+    ltl_fill_ghost_rows(buf, rows, nw, 1, periodic);
 }
 
 // ghost = leading ghost rows in buf (1 for the padded layout, 0 interior-only)
@@ -237,6 +234,164 @@ void swar_unpack(const uint64_t* buf, uint8_t* grid, int64_t rows, int64_t cols,
 
 bool swar_eligible(int64_t cols, int radius) {
     return radius == 1 && cols % 64 == 0 && cols > 0;
+}
+
+// ---------------------------------------------------------------------------
+// Bit-sliced radius-r (Larger-than-Life) engine — the native mirror of
+// ops/bitltl.py.  Per-cell integers live as uint64 bit planes (plane k
+// holds bit k of each cell's value, 64 cells per word): a ripple
+// carry-save accumulation of the 2r+1 vertically adjacent row words
+// builds each column's sum (<=4 planes), shifted copies with cross-word
+// carry bits are ripple-added into the <=8-plane neighborhood total, and
+// B/S membership is an MSB-first bit-sliced comparator over count
+// intervals derived from the rule tables.  The total includes the center
+// cell, so survive intervals are tested shifted by +1 (no bit-sliced
+// subtraction), exactly as the Python engine does.
+// ---------------------------------------------------------------------------
+
+static std::vector<std::pair<int, int>> table_intervals(const uint8_t* t,
+                                                        int n) {
+    std::vector<std::pair<int, int>> out;
+    int lo = -1;
+    for (int c = 0; c <= n; ++c) {
+        const bool on = c < n && t[c];
+        if (on && lo < 0) lo = c;
+        if (!on && lo >= 0) { out.push_back({lo, c - 1}); lo = -1; }
+    }
+    return out;
+}
+
+static inline int bit_len(int v) {
+    int n = 0;
+    while (v >> n) ++n;
+    return n;
+}
+
+// mask of cells whose bit-sliced value (planes t[0..np), LSB first) >= T
+static inline uint64_t bs_ge_word(const uint64_t* t, int np, int T) {
+    if (T <= 0) return ~0ull;
+    if (T >= (1 << np)) return 0ull;
+    uint64_t gt = 0, eq = ~0ull;
+    for (int k = np - 1; k >= 0; --k) {
+        const uint64_t p = t[k];
+        if ((T >> k) & 1) {
+            eq &= p;
+        } else {
+            gt |= eq & p;
+            eq &= ~p;
+        }
+    }
+    return gt | eq;
+}
+
+// ripple-add b (nb planes) into a (na planes); na must cover the maximum
+static inline void add_planes(uint64_t* a, int na, const uint64_t* b, int nb) {
+    uint64_t carry = 0;
+    for (int p = 0; p < na; ++p) {
+        const uint64_t x = a[p], y = p < nb ? b[p] : 0;
+        a[p] = x ^ y ^ carry;
+        carry = (x & y) | (carry & (x ^ y));
+    }
+}
+
+// one generation of rows [lo_row, hi_row) on an r-ghost-row padded packed
+// buffer; vplanes is nv*nw scratch for the per-row vertical sums
+static void ltl_gen_rows(const uint64_t* cur, uint64_t* nxt, int64_t nw,
+                         int64_t lo_row, int64_t hi_row, int r, bool periodic,
+                         const std::vector<std::pair<int, int>>& birth_iv,
+                         const std::vector<std::pair<int, int>>& survive_iv,
+                         int nv, int np, uint64_t* vplanes) {
+    for (int64_t i = lo_row; i < hi_row; ++i) {
+        for (int64_t j = 0; j < nw; ++j) {
+            uint64_t planes[4] = {0, 0, 0, 0};
+            for (int d = -r; d <= r; ++d) {
+                uint64_t bit = cur[(i + d) * nw + j];
+                for (int p = 0; p < nv; ++p) {
+                    const uint64_t s = planes[p] ^ bit;
+                    bit = planes[p] & bit;
+                    planes[p] = s;
+                }
+            }
+            for (int p = 0; p < nv; ++p) vplanes[p * nw + j] = planes[p];
+        }
+        uint64_t* out = nxt + i * nw;
+        for (int64_t j = 0; j < nw; ++j) {
+            const int64_t jp = j > 0 ? j - 1 : nw - 1;
+            const int64_t jn = j < nw - 1 ? j + 1 : 0;
+            const bool wl = j > 0 || periodic;
+            const bool wr = j < nw - 1 || periodic;
+            uint64_t t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+            for (int p = 0; p < nv; ++p) t[p] = vplanes[p * nw + j];
+            for (int d = 1; d <= r; ++d) {
+                uint64_t addL[4], addR[4];
+                for (int p = 0; p < nv; ++p) {
+                    const uint64_t vj = vplanes[p * nw + j];
+                    const uint64_t vp = wl ? vplanes[p * nw + jp] : 0;
+                    const uint64_t vn = wr ? vplanes[p * nw + jn] : 0;
+                    addL[p] = (vj << d) | (vp >> (64 - d));  // column j-d
+                    addR[p] = (vj >> d) | (vn << (64 - d));  // column j+d
+                }
+                add_planes(t, np, addL, nv);
+                add_planes(t, np, addR, nv);
+            }
+            uint64_t born = 0, stay = 0;
+            for (const auto& iv : birth_iv)
+                born |= bs_ge_word(t, np, iv.first) &
+                        ~bs_ge_word(t, np, iv.second + 1);
+            // total = count + 1 for alive cells (center included)
+            for (const auto& iv : survive_iv)
+                stay |= bs_ge_word(t, np, iv.first + 1) &
+                        ~bs_ge_word(t, np, iv.second + 2);
+            const uint64_t alive = cur[i * nw + j];
+            out[j] = (alive & stay) | (~alive & born);
+        }
+    }
+}
+
+static void ltl_fill_ghost_rows(uint64_t* buf, int64_t rows, int64_t nw,
+                                int r, bool periodic) {
+    for (int g = 0; g < r; ++g) {
+        uint64_t* top = buf + g * nw;
+        uint64_t* bot = buf + (rows + r + g) * nw;
+        if (periodic) {
+            // top ghost g is global row rows-r+g = buffer row rows+g;
+            // bottom ghost g is global row g = buffer row r+g
+            std::memcpy(top, buf + (rows + g) * nw, (size_t)nw * 8);
+            std::memcpy(bot, buf + (r + g) * nw, (size_t)nw * 8);
+        } else {
+            std::memset(top, 0, (size_t)nw * 8);
+            std::memset(bot, 0, (size_t)nw * 8);
+        }
+    }
+}
+
+bool ltl_eligible(int64_t rows, int64_t cols, int radius) {
+    return radius > 1 && radius <= 7 && cols % 64 == 0 && cols > 0 &&
+           rows >= 2 * radius + 1;
+}
+
+void ltl_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
+                const uint8_t* birth_table, const uint8_t* survive_table,
+                int r, bool periodic) {
+    const int64_t nw = cols / 64;
+    const int side = 2 * r + 1;
+    const int nmax = side * side - 1;
+    const int nv = bit_len(side);       // vertical sums reach 2r+1
+    const int np = bit_len(side * side);  // totals reach (2r+1)^2
+    const auto birth_iv = table_intervals(birth_table, nmax + 1);
+    const auto survive_iv = table_intervals(survive_table, nmax + 1);
+    std::vector<uint64_t> a((size_t)((rows + 2 * r) * nw), 0);
+    std::vector<uint64_t> b((size_t)((rows + 2 * r) * nw), 0);
+    std::vector<uint64_t> vplanes((size_t)(nv * nw));
+    swar_pack(grid, a.data(), rows, cols, r);
+    uint64_t *cur = a.data(), *nxt = b.data();
+    for (int64_t s = 0; s < steps; ++s) {
+        ltl_fill_ghost_rows(cur, rows, nw, r, periodic);
+        ltl_gen_rows(cur, nxt, nw, r, rows + r, r, periodic,
+                     birth_iv, survive_iv, nv, np, vplanes.data());
+        std::swap(cur, nxt);
+    }
+    swar_unpack(cur, grid, rows, cols, r);
 }
 
 // ---------------------------------------------------------------------------
@@ -536,6 +691,11 @@ void gol_step(const uint8_t* in, uint8_t* out, int64_t rows, int64_t cols,
 void gol_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                 const uint8_t* birth_table, const uint8_t* survive_table,
                 int radius, int periodic) {
+    if (ltl_eligible(rows, cols, radius) && steps > 0) {
+        ltl_evolve(grid, rows, cols, steps, birth_table, survive_table,
+                   radius, periodic != 0);
+        return;
+    }
     if (swar_eligible(cols, radius) && rows >= 1 && steps > 0) {
         const int64_t nw = cols / 64;
         if (swar_try_blocked(grid, rows, cols, birth_table, survive_table,

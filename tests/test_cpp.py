@@ -250,3 +250,37 @@ def test_cpp_swar_temporal_blocking_multiblock_serial(monkeypatch):
     np.testing.assert_array_equal(
         evolve_cpp(g, 16, LIFE, "periodic"),
         evolve_np(g, 16, LIFE, "periodic"))
+
+
+def test_cpp_ltl_bitsliced_path_matches_oracle():
+    # 64-aligned widths + radius > 1 route gol_evolve through the native
+    # bit-sliced LtL engine (ltl_eligible); parity vs the numpy oracle
+    # and vs the byte engine (via a non-aligned width) pins both paths
+    from mpi_tpu.models.rules import BOSCO, Rule, rule_from_name
+
+    rules = [
+        BOSCO,
+        rule_from_name("R2,B10-13,S8-12"),
+        Rule("r7", frozenset(range(80, 101)), frozenset(range(75, 120)),
+             radius=7),
+    ]
+    for rule in rules:
+        for boundary in ("periodic", "dead"):
+            g = init_tile_np(48, 192, seed=3)
+            np.testing.assert_array_equal(
+                evolve_cpp(g, 4, rule, boundary),
+                evolve_np(g, 4, rule, boundary),
+                err_msg=f"{rule.name} {boundary}",
+            )
+
+
+def test_cpp_ltl_small_rows_fall_back_to_byte_engine():
+    # rows < 2r+1 are not ltl_eligible (periodic ghost-row copy would
+    # alias); the byte engine must serve them, still bit-exactly
+    from mpi_tpu.models.rules import BOSCO
+
+    g = init_tile_np(8, 128, seed=9)
+    np.testing.assert_array_equal(
+        evolve_cpp(g, 3, BOSCO, "periodic"),
+        evolve_np(g, 3, BOSCO, "periodic"),
+    )

@@ -133,3 +133,18 @@ def test_two_process_multihost_resume(tmp_path, rows, cols, name):
     final = golio.assemble(str(tmp_path), name, 16)
     ref = evolve_np(init_tile_np(rows, cols, seed=5), 16, LIFE, "periodic")
     np.testing.assert_array_equal(final, ref)
+
+
+def test_two_process_multihost_ltl_engine(tmp_path):
+    # radius-2 rule + word-aligned shard widths route the multihost run
+    # through the sharded bit-sliced LtL stepper (run_tpu ltl_mode
+    # "sharded"); tiles from both hosts must reassemble to the oracle
+    from mpi_tpu.models.rules import rule_from_name
+
+    rule = rule_from_name("R2,B10-13,S8-12")
+    _run_group(str(tmp_path),
+               ["64", "256", "16", "16", "--rule", "R2,B10-13,S8-12"])
+    name = "run-64x256-16-s5"
+    final = golio.assemble(str(tmp_path), name, 16)
+    ref = evolve_np(init_tile_np(64, 256, seed=5), 16, rule, "periodic")
+    np.testing.assert_array_equal(final, ref)

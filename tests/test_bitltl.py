@@ -235,3 +235,15 @@ def test_run_tpu_single_device_comm_every_uses_sharded_ltl(monkeypatch):
     np.testing.assert_array_equal(
         out, evolve_np(init_tile_np(24, 128, seed=5), 4, R2, "periodic")
     )
+
+
+def test_pallas_ltl_radius7_tightest_halo():
+    # r=7 is the tightest case for the fixed 8-row DMA halo: vertical
+    # slab slices reach halo row 1 (a-d >= 8-7), one row from the edge
+    g = init_tile_np(32, 4096, seed=21)
+    p = jnp.asarray(pack_np(g))
+    for _ in range(2):
+        p = pallas_ltl_step(p, R7, "periodic", interpret=True, blocks=(16, 8))
+    np.testing.assert_array_equal(
+        unpack_np(np.asarray(p)), evolve_np(g, 2, R7, "periodic")
+    )

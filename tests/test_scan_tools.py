@@ -1,0 +1,69 @@
+"""The shared scan harness (tools/scan_common.py) used by compile_wall,
+width_scan, and engine_ladder: every child failure shape must become an
+{"error": ...} row, never a crash that aborts a multi-hour scan."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import scan_common  # noqa: E402
+
+
+class _P:
+    def __init__(self, rc=0, stdout="", stderr=""):
+        self.returncode = rc
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _with_run(monkeypatch, fn):
+    monkeypatch.setattr(scan_common.subprocess, "run", fn)
+
+
+def test_run_child_parses_last_json_line(monkeypatch):
+    _with_run(monkeypatch, lambda *a, **k: _P(
+        stdout='WARNING: banner\n{"gcells_per_s": 5.0}\n'))
+    assert scan_common.run_child("x.py", (1, 2), 10) == {"gcells_per_s": 5.0}
+
+
+def test_run_child_timeout(monkeypatch):
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=10)
+
+    _with_run(monkeypatch, boom)
+    out = scan_common.run_child("x.py", (), 10)
+    assert out["error"].startswith("TIMEOUT")
+
+
+def test_run_child_nonzero_exit(monkeypatch):
+    _with_run(monkeypatch, lambda *a, **k: _P(
+        rc=1, stderr="Trace\nRuntimeError: VMEM OOM"))
+    out = scan_common.run_child("x.py", (), 10)
+    assert "VMEM OOM" in out["error"]
+
+
+def test_run_child_unparseable_stdout(monkeypatch):
+    _with_run(monkeypatch, lambda *a, **k: _P(stdout="no json here"))
+    out = scan_common.run_child("x.py", (), 10)
+    assert "unparseable" in out["error"]
+    _with_run(monkeypatch, lambda *a, **k: _P(stdout=""))
+    out = scan_common.run_child("x.py", (), 10)
+    assert "unparseable" in out["error"]
+
+
+def test_write_out_roundtrip(tmp_path):
+    path = str(tmp_path / "sub" / "scan.json")
+    rows = [{"a": 1}, {"error": "TIMEOUT>10s"}]
+    scan_common.write_out(path, rows)
+    assert json.load(open(path)) == rows
+
+
+def test_steps_for_budget_invariants():
+    for budget, cells, gens in ((8e12, 16384 * 16384, 8),
+                                (1e6, 65536 * 65536, 16),
+                                (2e12, 4096 * 4096, 1)):
+        steps = scan_common.steps_for_budget(budget, cells, gens)
+        assert steps >= gens and steps % gens == 0

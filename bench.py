@@ -14,12 +14,25 @@ block_until_ready alone under-reports on this platform).
 Robustness (this file is the driver's only perf capture, so it must not
 crash): every JAX touch happens in a *subprocess* with a hard timeout —
 the TPU tunnel can hang ``jax.devices()`` indefinitely, and an in-process
-hang is unkillable.  The parent first probes device reachability with a
-short timeout (retrying with backoff), then walks a fallback ladder of
-grid sizes (65536² → 32768² → 16384² → 8192²), and if the TPU is
-unreachable takes a degraded CPU measurement with the XLA SWAR engine
-instead.  Whatever happens, the parent prints one JSON line (with a
-"degraded"/"error" field when applicable) and exits 0.
+hang is unkillable.  Capture order (VERDICT r2 item 1 — bank hardware
+evidence early, the tunnel can die mid-window):
+
+1. probe reachability — 3 quick attempts, then an extended re-probe
+   window (the tunnel outages last minutes-to-hours but the capture
+   window is long; giving up after three 150 s probes left two rounds
+   degraded);
+2. BANK a cheap rung first: 8192² in a ~1-minute budget, persisted to
+   ``perf/bench_tpu_verified.json`` immediately — from this point the
+   round has an undegraded TPU number whatever happens next;
+3. climb the ladder to the 65536² flagship (largest size wins the
+   output; the bank rung is the floor, not the ceiling);
+4. if the TPU produced nothing, a degraded CPU measurement with the XLA
+   SWAR engine.
+
+Whatever happens, the parent prints one JSON line (with a
+"degraded"/"error"/"note" field when applicable) and exits 0.  A
+platform="tpu" result is never marked degraded; a smaller-than-flagship
+size is a "note", not a degradation.
 
 vs_baseline: ratio to the north star's per-chip share — BASELINE.json
 targets >= 1e11 cells/s on v5e-64, i.e. 1.5625e9 per chip.
@@ -51,9 +64,13 @@ ATTEMPTS_PER_SIZE = 2
 BACKOFF_S = (5.0, 20.0)
 RECOVERY_WAIT_S = 120.0  # endpoint-recovery pause after a fast-failing ladder
 TIMEOUT_S = {65536: 1200, 32768: 900, 16384: 720, 8192: 600}
-PROBE_ATTEMPTS = 3
+PROBE_ATTEMPTS = 3  # quick phase, short backoff
+PROBE_EXTENDED_ATTEMPTS = 5  # extended window: a minute between attempts
 PROBE_TIMEOUT_S = 150
 PROBE_BACKOFF_S = (20.0, 40.0)
+PROBE_EXTENDED_SLEEP_S = 60.0
+BANK_SIZE = 8192  # cheap rung banked before the ladder climb
+BANK_TIMEOUT_S = 420
 CPU_SIZE = 8192
 CPU_STEPS = 16
 CPU_TIMEOUT_S = 600
@@ -197,23 +214,31 @@ def _verified_path() -> str:
     return _perf_path("MPI_TPU_BENCH_VERIFIED", "bench_tpu_verified.json")
 
 
-def _record_verified(out) -> None:
-    """Persist the best undegraded TPU measurement to a dedicated file
-    that degraded runs never overwrite — so a tunnel outage at capture
-    time cannot erase the hardware evidence.  Atomic replace: a kill or
-    disk-full mid-write must not truncate the existing record."""
+def _record_verified(out, history=None) -> None:
+    """Persist an undegraded TPU measurement to a dedicated file that
+    degraded runs never overwrite — so a tunnel outage at capture time
+    cannot erase the hardware evidence.  Records are kept per grid size
+    (the banked 8192² rung runs intrinsically faster than the 65536²
+    flagship — width penalty — and must never shadow it).  Atomic
+    replace: a kill or disk-full mid-write must not truncate the
+    existing record.  A suppressed persistence failure is appended to
+    ``history`` so a lost record leaves a trace in the attempt artifact
+    (ADVICE r2: bench.py:214)."""
     try:
-        prev = _load_verified()
+        recs = _load_verified_records()
+        key = str(out.get("size"))
+        prev = recs.get(key)
         if prev is not None and prev["value"] >= out["value"]:
             return
         payload = dict(out)
         payload["measured_at_unix"] = int(time.time())
+        recs[key] = payload
         path = _verified_path()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
         try:
             with open(tmp, "w") as f:
-                json.dump(payload, f, indent=1)
+                json.dump({"records": recs}, f, indent=1)
             os.replace(tmp, path)
         except OSError:
             # never leave a half-written .tmp in the committed perf/ dir
@@ -222,23 +247,47 @@ def _record_verified(out) -> None:
             except OSError:
                 pass
             raise
-    except OSError:
-        pass
+    except OSError as e:
+        if history is not None:
+            history.append(f"persist-error:{type(e).__name__}: {e}"[:160])
 
 
-def _load_verified():
+def _load_verified_records() -> dict:
+    """size-string → record.  Reads both the v2 {"records": {...}} layout
+    and the legacy single-record file; hand-edited/corrupt entries are
+    dropped rather than crashing a run (only dicts with a numeric value
+    survive — the >= comparison and evidence attachment both need it)."""
     try:
         with open(_verified_path()) as f:
             out = json.load(f)
-        # a hand-edited or corrupt record must never crash a run: only a
-        # dict with a numeric value is usable (for the >= comparison in
-        # _record_verified and as attachable evidence)
-        if isinstance(out, dict) and isinstance(out.get("value"), (int, float)):
-            return out
-        return None
     except (OSError, ValueError):
         # ValueError covers JSONDecodeError and UnicodeDecodeError alike
+        return {}
+    if not isinstance(out, dict):
+        return {}
+    raw = out.get("records")
+    if not isinstance(raw, dict):
+        # legacy: the file IS one record
+        raw = {str(out.get("size")): out}
+    return {
+        k: v for k, v in raw.items()
+        if isinstance(v, dict) and isinstance(v.get("value"), (int, float))
+    }
+
+
+def _load_verified():
+    """The flagship evidence: the record at the largest grid size."""
+    recs = _load_verified_records()
+    if not recs:
         return None
+
+    def size_of(k):
+        try:
+            return int(k)
+        except ValueError:
+            return -1
+
+    return recs[max(recs, key=size_of)]
 
 
 def _write_artifact(out, history) -> None:
@@ -261,11 +310,21 @@ def _write_artifact(out, history) -> None:
 def _main_inner():
     history = []
     result = None
+    # snapshot the flagship evidence BEFORE this capture records anything:
+    # attached "prior" evidence must be genuinely prior (a first-ever run
+    # that banks 8192^2 must not see its own record labeled "NOT produced
+    # by this run")
+    prior_flagship = _load_verified()
 
     # 1. Reachability probe: a dead tunnel hangs jax.devices(), so find out
     #    cheaply instead of burning the ladder's long timeouts on it.
+    #    Quick phase first; if that fails, keep re-probing on a slower
+    #    cadence — outages are minutes-to-hours and the capture window is
+    #    long, so giving up after three probes forfeits rounds where the
+    #    tunnel comes back (VERDICT r2 item 1).
     tpu_ok = False
-    for i in range(PROBE_ATTEMPTS):
+    total_probes = PROBE_ATTEMPTS + PROBE_EXTENDED_ATTEMPTS
+    for i in range(total_probes):
         res, note = run_sub(["--probe"], PROBE_TIMEOUT_S)
         if res is not None:
             tpu_ok = res.get("platform") == "tpu"
@@ -278,11 +337,32 @@ def _main_inner():
         # tunnel may be back seconds later
         if i + 1 < PROBE_ATTEMPTS:
             time.sleep(PROBE_BACKOFF_S[min(i, len(PROBE_BACKOFF_S) - 1)])
+        elif i + 1 < total_probes:
+            time.sleep(PROBE_EXTENDED_SLEEP_S)
 
-    # 2. Size ladder on the real device.
+    # 2. BANK a cheap rung before the expensive climb: ~1-minute budget at
+    #    8192², persisted immediately — whatever the tunnel does later,
+    #    the round now holds an undegraded TPU number from THIS capture.
+    bank = None
+    if tpu_ok:
+        res, note = run_sub(
+            ["--child", str(BANK_SIZE), str(STEPS_BY_SIZE[BANK_SIZE]),
+             str(GENS)], BANK_TIMEOUT_S,
+        )
+        history.append(f"bank-{BANK_SIZE}:{note[:160]}")
+        if res is not None and res.get("platform") == "tpu":
+            bank = res
+            _record_verified(_clean_record(res), history)
+
+    # 3. Size ladder on the real device, largest (flagship) first.  The
+    #    banked rung already covers BANK_SIZE; it re-enters the ladder
+    #    only if the bank attempt failed.
     ladder_timed_out = False
     if tpu_ok:
-        for size in SIZES:
+        ladder = [s for s in SIZES if s > BANK_SIZE]
+        if bank is None:
+            ladder.append(BANK_SIZE)
+        for size in ladder:
             for i in range(ATTEMPTS_PER_SIZE):
                 res, note = run_sub(
                     ["--child", str(size), str(STEPS_BY_SIZE[size]),
@@ -298,12 +378,12 @@ def _main_inner():
             if result is not None:
                 break
 
-    # 2a. Endpoint-recovery retry: round 1 failed with a healthy device
+    # 3a. Endpoint-recovery retry: round 1 failed with a healthy device
     #     but a refused remote-compile endpoint — if every ladder attempt
     #     failed FAST that way (no slow timeouts: a timed-out ladder
     #     already burned hours and will not benefit from one more try),
-    #     give the endpoint one longer window to recover before
-    #     surrendering to the CPU fallback.
+    #     give the endpoint one longer window to recover before falling
+    #     back to the banked rung / CPU measurement.
     if result is None and tpu_ok and not ladder_timed_out:
         time.sleep(RECOVERY_WAIT_S)
         res, note = run_sub(
@@ -315,7 +395,12 @@ def _main_inner():
         if res is not None:
             result = res
 
-    # 2b. Opportunistic deeper temporal blocking: gens=16 halves the HBM
+    # 3b. The banked rung is the floor: a failed climb still reports a
+    #     real TPU measurement from this capture.
+    if result is None:
+        result = bank
+
+    # 3c. Opportunistic deeper temporal blocking: gens=16 halves the HBM
     #     round-trips again.  Measured 2026-07-30: it did NOT beat gens=8
     #     at 65536^2 (the kernel is compute-bound; see PERF.md) — kept
     #     because it is strictly keep-the-max (a compile failure, timeout,
@@ -331,8 +416,9 @@ def _main_inner():
         if res is not None and res["value"] > result["value"]:
             result = res
 
-    # 3. Degraded CPU measurement if the TPU path produced nothing.
+    # 4. Degraded CPU measurement if the TPU path produced nothing.
     degraded = None
+    note_field = None
     if result is None:
         res, note = run_sub(
             ["--child", str(CPU_SIZE), str(CPU_STEPS), str(GENS)],
@@ -348,7 +434,12 @@ def _main_inner():
     elif result.get("platform") != "tpu":
         degraded = f"non-tpu platform {result.get('platform')!r}"
     elif result["size"] != SIZES[0]:
-        degraded = f"fell back to {result['size']}^2 (larger sizes failed)"
+        # a real hardware number from this capture — NOT degraded, just
+        # not the flagship size (the prior flagship evidence rides along)
+        note_field = (
+            f"tpu result at {result['size']}^2; {SIZES[0]}^2 flagship "
+            f"rungs did not complete this capture"
+        )
 
     out = {
         "metric": "cell_updates_per_sec_single_chip",
@@ -363,21 +454,52 @@ def _main_inner():
             out["gens"] = result["gens"]
     if degraded:
         out["degraded"] = degraded
+    if note_field:
+        out["note"] = note_field
     if result is None:
         out["error"] = "all attempts failed"
         out["attempts"] = history
-    if degraded or result is None:
-        _attach_verified(out)
-    else:
-        _record_verified(out)
+    # record BEFORE attaching, and only the measurement fields: the
+    # verified file must hold clean evidence — never nested prior
+    # records, nor this capture's run-specific note/degraded fields
+    if result is not None and result.get("platform") == "tpu":
+        _record_verified(_clean_record(result), history)
+    if degraded or note_field or result is None:
+        _attach_verified(out, prior=prior_flagship)
     return out, history
 
 
-def _attach_verified(out) -> None:
+_LOAD_FROM_DISK = object()  # "no snapshot taken" — distinct from prior=None
+
+
+def _clean_record(res) -> dict:
+    """The measurement-only payload persisted as hardware evidence —
+    identical schema wherever the result came from (bank rung, ladder,
+    recovery), so attached evidence never varies in shape."""
+    clean = {
+        "metric": "cell_updates_per_sec_single_chip",
+        "value": round(res["value"], 1),
+        "unit": "cells/s",
+        "vs_baseline": round(res["value"] / BASELINE_PER_CHIP, 3),
+        "size": res["size"],
+        "platform": res["platform"],
+    }
+    if "gens" in res:
+        clean["gens"] = res["gens"]
+    return clean
+
+
+def _attach_verified(out, prior=_LOAD_FROM_DISK) -> None:
     # a dead tunnel at capture time must not erase the hardware
     # evidence: attach the persisted best undegraded TPU measurement,
-    # clearly labeled as prior (its measured_at_unix timestamps it)
-    prior = _load_verified()
+    # clearly labeled as prior (its measured_at_unix timestamps it).
+    # Callers that recorded during this capture pass the start-of-run
+    # snapshot — which may legitimately be None on a first-ever run, so
+    # the "load from disk" default is a distinct sentinel (this run's
+    # own fresh record must never be labeled prior) — while the crash
+    # guard, which recorded nothing, loads from disk.
+    if prior is _LOAD_FROM_DISK:
+        prior = _load_verified()
     if prior is not None:
         out["last_verified_tpu"] = prior
         out["last_verified_tpu_note"] = (

@@ -22,7 +22,11 @@ GAP=${GAP:-250}
 SEED=${SEED:-1}
 # Snapshots default ON (SAVE=0 disables): without --save the run would
 # produce no grid output at all on a multihost slice, where run_tpu
-# returns no final grid to the driver process.
+# returns no final grid to the driver process.  At this config the
+# snapshot-format auto threshold picks packed .golp tiles (1 bit/cell:
+# ~537 MB per 65536^2 snapshot instead of ~8.6 GB of .gol text); force
+# SNAPSHOT_FORMAT=gol only if reference-era tooling must read the tiles
+# directly.
 SAVE=${SAVE:-1}
 
 # MULTIHOST=1 joins the slice-wide process group (set it when launching on
@@ -37,4 +41,5 @@ SAVE_FLAG=--save
 # PYTHON override: test harnesses / venvs pin the exact interpreter
 "${PYTHON:-python}" -m mpi_tpu.cli "$GRID" "$GRID" "$GAP" "$ITERS" batch_timings "${FIRST:-1}" \
   --backend tpu --seed "$SEED" --name "$NAME" $SAVE_FLAG \
+  --snapshot-format "${SNAPSHOT_FORMAT:-auto}" \
   ${MULTIHOST:+--multihost} --out-dir "${OUT_DIR:-.}"

@@ -22,6 +22,7 @@
 //
 // Exposed via a C ABI for the ctypes wrapper in backends/cpp.py.
 
+#include <chrono>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -734,9 +735,14 @@ void gol_evolve(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
 
 // Parallel evolution over a ti x tj worker-tile mesh (one thread per tile).
 // Requires rows % ti == 0 and cols % tj == 0; returns 0 on success.
-int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
-                   const uint8_t* birth_table, const uint8_t* survive_table,
-                   int radius, int periodic, int ti, int tj) {
+// worker_us (nullable): ti*tj slots, each ACCUMULATING its worker thread's
+// measured wall time inside the evolve loop (includes barrier waits — the
+// per-rank duration the reference's MPI_Reduce summed, main.cpp:319-324);
+// accumulation lets segmented callers (snapshot gaps) total across calls.
+int gol_evolve_par_t(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
+                     const uint8_t* birth_table, const uint8_t* survive_table,
+                     int radius, int periodic, int ti, int tj,
+                     int64_t* worker_us) {
     if (ti < 1 || tj < 1 || rows % ti || cols % tj) return 1;
     if (swar_eligible(cols, radius) && rows >= 1) {
         // Packed engine: the requested ti x tj mesh supplies the worker
@@ -748,9 +754,21 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
         int w = ti * tj;
         if ((int64_t)w > rows) w = (int)rows;
         const int64_t nw = cols / 64;
-        if (swar_try_blocked(grid, rows, cols, birth_table, survive_table,
-                             steps, periodic, w))
-            return 0;
+        {
+            auto b0 = std::chrono::steady_clock::now();
+            if (swar_try_blocked(grid, rows, cols, birth_table, survive_table,
+                                 steps, periodic, w)) {
+                if (worker_us) {
+                    // the blocked engine forks/joins its workers every block
+                    // row, so each worker's measured span is the whole call
+                    int64_t us = std::chrono::duration_cast<
+                        std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - b0).count();
+                    for (int t = 0; t < w; ++t) worker_us[t] += us;
+                }
+                return 0;
+            }
+        }
         std::vector<uint64_t> a((size_t)((rows + 2) * nw), 0);
         std::vector<uint64_t> b((size_t)((rows + 2) * nw), 0);
         swar_pack(grid, a.data(), rows, cols, 1);
@@ -763,6 +781,7 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                 const int64_t lo = 1 + rows * t / w;
                 const int64_t hi = 1 + rows * (t + 1) / w;
                 threads.emplace_back([=, &barrier]() {
+                    auto w0 = std::chrono::steady_clock::now();
                     SwarScratch scr(nw);
                     int cur = 0;
                     for (int64_t s = 0; s < steps; ++s) {
@@ -776,6 +795,10 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                         cur = 1 - cur;
                         barrier.arrive_and_wait();  // all bands written
                     }
+                    if (worker_us)
+                        worker_us[t] += std::chrono::duration_cast<
+                            std::chrono::microseconds>(
+                            std::chrono::steady_clock::now() - w0).count();
                 });
             }
             for (auto& th : threads) th.join();
@@ -810,7 +833,8 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
     for (int i = 0; i < ti; ++i) {
         for (int j = 0; j < tj; ++j) {
             workers.emplace_back([&e, &barrier, i, j, steps, birth_table,
-                                  survive_table]() {
+                                  survive_table, worker_us]() {
+                auto w0 = std::chrono::steady_clock::now();
                 Tile& t = e.at(i, j);
                 RuleTables rule{birth_table, survive_table, e.radius};
                 bool cur_is_a = true;
@@ -823,6 +847,10 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                     cur_is_a = !cur_is_a;
                     barrier.arrive_and_wait();  // all interiors written
                 }
+                if (worker_us)
+                    worker_us[(size_t)i * e.tj + j] += std::chrono::duration_cast<
+                        std::chrono::microseconds>(
+                        std::chrono::steady_clock::now() - w0).count();
             });
         }
     }
@@ -839,6 +867,14 @@ int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
         }
     }
     return 0;
+}
+
+// Untimed entry (the ctypes binding's stable surface).
+int gol_evolve_par(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
+                   const uint8_t* birth_table, const uint8_t* survive_table,
+                   int radius, int periodic, int ti, int tj) {
+    return gol_evolve_par_t(grid, rows, cols, steps, birth_table,
+                            survive_table, radius, periodic, ti, tj, nullptr);
 }
 
 }  // extern "C"

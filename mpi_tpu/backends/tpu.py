@@ -91,17 +91,66 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
     )
 
 
+def select_ltl_mode(config: GolConfig, mi: int, mj: int):
+    """Engine choice for a radius > 1 rule: ``("pallas" | "sharded" |
+    None, note)``.  None means the dense path serves the run; ``note``
+    (when set) explains a fallback off the fast bit-sliced engine so the
+    user sees why their run is on the slow path instead of a silent
+    ~3.6x cliff (ADVICE r2: tpu.py:212).  Pure dispatch — no devices
+    touched beyond the platform gate — so tests can pin the policy."""
+    r = config.rule.radius
+    if r <= 1:
+        return None, None
+    if (config.cols // mj) % 32 != 0:
+        return None, (
+            f"radius-{r} rule on non-word-aligned shard width "
+            f"({config.cols}/{mj} cols per shard): dense engine "
+            f"(bit-sliced needs a multiple of 32)"
+        )
+    if mi * mj == 1 and _ltl_single_device(config):
+        return "pallas", None
+    if config.comm_every * r > 31:
+        return None, (
+            f"comm_every {config.comm_every} x radius {r} > 31 exceeds the "
+            f"one-ghost-word halo: dense engine (~3.6x slower at r=5; use "
+            f"comm_every <= {31 // r} to keep the bit-sliced engine)"
+        )
+    if mi * mj > 1:
+        return "sharded", None
+    # single device + comm_every > 1: the fused kernel has no temporal
+    # blocking, but the sharded stepper on a 1x1 mesh (self-wrapping
+    # exchange) still beats dense on TPU-class tiles; off-TPU production
+    # keeps dense (measured slower on CPU at radius 5)
+    if config.comm_every > 1 and _pallas_single_device_mode()[0]:
+        return "sharded", None
+    if config.comm_every > 1:
+        return None, (
+            f"radius-{r} with comm_every > 1 off-TPU: dense engine "
+            f"(bit-sliced measured slower than dense on CPU)"
+        )
+    if _pallas_single_device_mode()[0]:
+        # on-TPU the fused kernel declined on shape alone — a real perf
+        # cliff worth naming
+        return None, (
+            f"radius-{r} fused kernel unavailable for this shape: "
+            f"dense engine"
+        )
+    # off-TPU single device at comm_every == 1: dense IS the intended
+    # (measured-faster) path there — not a degradation, no note
+    return None, None
+
+
 def _ltl_single_device(config: GolConfig) -> bool:
     """Serve a radius > 1 rule with the fused bit-sliced LtL kernel
-    (ops/pallas_bitltl.py)?  Single-device, comm_every == 1 (the kernel
-    has no temporal blocking), packable width, and the same TPU gating
-    as the other Pallas dispatches.  Measured (PERF.md): 124 Gcell/s for
-    Bosco vs 34 for the best dense engine."""
-    if config.comm_every != 1:
-        return False
+    (ops/pallas_bitltl.py)?  Single-device, packable width, comm_every
+    within the kernel's temporal-blocking depth (gens ≤ ⌊8/r⌋ — so
+    r ≥ 5 only at comm_every 1), and the same TPU gating as the other
+    Pallas dispatches.  Measured (PERF.md): 124 Gcell/s for Bosco vs 34
+    for the best dense engine."""
     from mpi_tpu.ops.pallas_bitltl import supports
 
-    if not supports((config.rows, config.cols), config.rule):
+    if not supports((config.rows, config.cols), config.rule,
+                    gens=config.comm_every):
         return False
     use, _ = _pallas_single_device_mode()
     return use
@@ -202,23 +251,13 @@ def run_tpu(
     # radius > 1: the packed bit-sliced LtL engine replaces the dense path
     # when it applies (same packed init/snapshot plumbing) — the fused
     # Pallas kernel on one device, the shard_map/ppermute XLA stepper on
-    # meshes (overlap stays with the dense stepper, which implements it)
-    ltl_mode = None
-    if not packed_mode and config.rule.radius > 1 \
-            and (config.cols // mj) % WORD == 0:
-        if mi * mj == 1 and _ltl_single_device(config):
-            ltl_mode = "pallas"
-        elif config.comm_every * config.rule.radius <= 31 and (
-            (mi * mj > 1 and not config.overlap)
-            # single device + comm_every > 1: the fused kernel has no
-            # temporal blocking, but the sharded stepper on a 1x1 mesh
-            # (self-wrapping exchange) still beats dense on TPU-class
-            # tiles; off-TPU production keeps dense (measured slower on
-            # CPU at radius 5)
-            or (mi * mj == 1 and config.comm_every > 1
-                and _pallas_single_device_mode()[0])
-        ):
-            ltl_mode = "sharded"
+    # meshes (with stitched-band overlap when requested)
+    ltl_mode, ltl_note = (None, None) if packed_mode \
+        else select_ltl_mode(config, mi, mj)
+    if ltl_note is not None:
+        import sys
+
+        print(f"note: {ltl_note}", file=sys.stderr)
     if config.overlap and mi * mj > 1:
         # fail fast instead of silently running without the requested
         # overlap: tiles must be big enough for the stitched edge bands
@@ -230,6 +269,14 @@ def run_tpu(
                 raise ConfigError(
                     f"--overlap needs tiles >= {2 * config.comm_every} rows "
                     f"x {2 * WORD} cols (got {tile_r}x{tile_c})"
+                )
+        elif ltl_mode == "sharded":
+            d = config.comm_every * config.rule.radius
+            if tile_r < 2 * d or tile_c < 2 * WORD:
+                raise ConfigError(
+                    f"--overlap needs tiles >= {2 * d} rows x {2 * WORD} "
+                    f"cols for the bit-sliced radius-{config.rule.radius} "
+                    f"bands (got {tile_r}x{tile_c})"
                 )
         else:
             d = 2 * config.comm_every * config.rule.radius
@@ -249,14 +296,15 @@ def run_tpu(
 
             _, interpret = _pallas_single_device_mode()
             evolve = make_pallas_ltl_stepper(
-                config.rule, config.boundary, interpret=interpret
+                config.rule, config.boundary, interpret=interpret,
+                gens=config.comm_every,
             )
         elif ltl_mode == "sharded":
             from mpi_tpu.parallel.step import make_sharded_ltl_stepper
 
             evolve = make_sharded_ltl_stepper(
                 mesh, config.rule, config.boundary,
-                gens_per_exchange=config.comm_every,
+                gens_per_exchange=config.comm_every, overlap=config.overlap,
             )
         else:
             evolve = _pick_packed_evolve(config, mesh, mi * mj)

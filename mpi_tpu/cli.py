@@ -55,13 +55,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--save", action="store_true",
                    help="write .gol snapshots every iteration_gap steps")
+    p.add_argument("--snapshot-format", choices=["auto", "gol", "golp"],
+                   default="auto",
+                   help="snapshot tile format: gol = reference-compatible "
+                   "tab-separated text (~2 bytes/cell), golp = packed "
+                   "binary (1 bit/cell — a 65536^2 snapshot drops from "
+                   "~8.6 GB to ~537 MB); auto picks text for small tiles "
+                   "and golp above %d cells/tile. Resume and the "
+                   "visualizer read both." % (1 << 24))
     p.add_argument("--out-dir", default=".")
     p.add_argument("--mesh", default=None, metavar="IxJ",
                    help="TPU device mesh shape, e.g. 2x4 (default: auto)")
     p.add_argument("--workers", type=int, default=0,
                    help="cpp-par worker threads (default: auto)")
-    p.add_argument("--comm-every", type=int, default=1, metavar="K",
-                   help="tpu backend: generations per halo exchange (1..16). "
+    p.add_argument("--comm-every", default="1", metavar="K",
+                   help="tpu backend: generations per halo exchange (1..16), "
+                   "or 'auto' to choose K and overlap from the mesh "
+                   "geometry plus a one-shot measured collective latency "
+                   "(parallel/policy.py; single-device runs keep K=1). "
                    "K > 1 exchanges a K-deep ghost ring and runs K local "
                    "generations between collectives (communication-avoiding; "
                    "the deep-halo optimization the reference's per-step "
@@ -160,6 +171,15 @@ def _run(args) -> int:
              f"{jax.local_device_count()} local of {jax.device_count()} devices")
     rule = rule_from_name(args.rule)
     mesh_shape = _parse_mesh(args.mesh)
+    auto_comm = args.comm_every == "auto"
+    if auto_comm and args.backend != "tpu":
+        raise ConfigError("--comm-every auto applies to the tpu backend only")
+    try:
+        comm_every = 1 if auto_comm else int(args.comm_every)
+    except ValueError:
+        raise ConfigError(
+            f"--comm-every must be an integer or 'auto', got {args.comm_every!r}"
+        )
     config = GolConfig(
         rows=args.rows,
         cols=args.cols,
@@ -172,7 +192,7 @@ def _run(args) -> int:
         mesh_shape=mesh_shape,
         out_dir=args.out_dir,
         workers=args.workers,
-        comm_every=args.comm_every,
+        comm_every=comm_every,
         overlap=args.overlap,
     )
     if args.strict:
@@ -249,6 +269,21 @@ def _run(args) -> int:
         else:
             effective_mesh = mesh_shape
         processes = effective_mesh[0] * effective_mesh[1]
+    if auto_comm and config.backend == "tpu":
+        import dataclasses
+
+        from mpi_tpu.parallel.policy import resolve_auto
+
+        auto_mesh = None
+        if processes > 1:
+            from mpi_tpu.parallel.mesh import make_mesh
+
+            auto_mesh = make_mesh(effective_mesh)
+        k, ov = resolve_auto(config, effective_mesh, mesh=auto_mesh)
+        config = dataclasses.replace(config, comm_every=k,
+                                     overlap=ov or config.overlap)
+        _log(args.quiet,
+             f"comm policy auto: comm_every={k}, overlap={config.overlap}")
     if args.strict:
         # judged against the decomposition that will actually run, not just
         # an explicit --mesh (reference rules, main.cpp:194-200)
@@ -283,7 +318,8 @@ def _run(args) -> int:
             for i in range(ti)
             for j in range(tj)
         ]
-        golio.write_snapshot_tiles(args.out_dir, name, iteration, tiles)
+        golio.write_snapshot_tiles(args.out_dir, name, iteration, tiles,
+                                   fmt=args.snapshot_format)
 
     if config.backend == "tpu":
         import contextlib
@@ -294,7 +330,8 @@ def _run(args) -> int:
             # tiles carry globally-unique pids (multi-host: each host
             # writes only its addressable shards)
             for pid, tile, r0, c0 in tiles:
-                golio.write_tile(args.out_dir, name, iteration, pid, tile, r0, c0)
+                golio.write_tile_fmt(args.out_dir, name, iteration, pid,
+                                     tile, r0, c0, fmt=args.snapshot_format)
             # Every host prunes tiles whose pid is not in the CURRENT
             # global writer set: a rerun of the same config-derived name
             # with fewer writers must not leave old tiles for assemble to

@@ -23,9 +23,11 @@ represents every per-cell integer as a list of uint32 *bit planes*
   per threshold), and the next state is
   ``(dead & born) | (alive & survives)``.
 
-Cost for Bosco (r=5): ~250 uint32 ops per 32-cell word ≈ 8 ops/cell —
-an order of magnitude under the dense path's ~120 ops/cell, with 8×
-less HBM traffic.  Everything is elementwise jnp on the packed (H,
+Cost for Bosco (r=5): ~1550 uint32 ops per 32-cell word ≈ 48 ops/cell
+pre-CSE (counted from the traced jaxpr by ``tools/roofline.py`` —
+round 3 corrected an earlier ~8 ops/cell estimate) vs the dense path's
+~121 ops *per cell* at 1 cell/lane, with 8× less HBM traffic; measured
+3.6× faster end-to-end (PERF.md).  Everything is elementwise jnp on the packed (H,
 W/32) uint32 layout shared with ``bitlife``, so XLA fuses the step and
 the identical code runs under ``lax.scan`` and inside ``shard_map``.
 

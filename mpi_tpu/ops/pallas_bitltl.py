@@ -18,10 +18,17 @@ out of VMEM:
   (``bs_add`` ripple adders, ``bs_ge`` comparators, +1-shifted survive
   intervals) applied to CM-row sub-tiles to bound live VMEM.
 
-No temporal blocking: gens=1 per pass (the radius-r dependence cone
-consumes r rows per side per generation, so the 8-row halo would allow
-only ⌊8/r⌋ generations — not worth the trapezoid complexity while the
-kernel is already compute-bound).
+Temporal blocking (``gens`` > 1, VERDICT r2 item 4): the radius-r
+dependence cone consumes r rows per side per generation, so the 8-row
+DMA halo admits ⌊8/r⌋ in-VMEM generations per HBM round-trip — 4 for
+r=2, 2 for r=3..4, nothing for r ≥ 5.  Same trapezoid machinery as
+``ops/pallas_bitlife.py``: each generation shrinks the valid row window
+by r per side, sub-tiles update the slab in place carrying the r
+overwritten neighbor rows in ``saved``, and dead-boundary edge slabs
+are re-killed between generations.  Whether it pays is an empirical
+question per radius (the kernel is near the compute roof at r=5, but
+shallower radii have fewer ops/cell and more bandwidth headroom) —
+measured on hardware via tools/ltl_gens_ladder.py, see PERF.md.
 """
 
 from __future__ import annotations
@@ -47,6 +54,11 @@ def _nplanes(radius: int) -> int:
     return max(1, total.bit_length())
 
 
+def max_gens(radius: int) -> int:
+    """Deepest temporal blocking the 8-row DMA halo admits."""
+    return max(1, HALO // radius)
+
+
 def _pick_blocks(H: int, NW: int, radius: int) -> Tuple[int, int] | None:
     """(BM, CM) slab/compute-tile rows.  The live working set is the
     double-buffered slab plus ~11 (CM, NW) u32 temporaries *per bit
@@ -54,12 +66,21 @@ def _pick_blocks(H: int, NW: int, radius: int) -> Tuple[int, int] | None:
     plane families plus comparator masks all scale with the plane
     count) — calibrated on hardware 2026-07-30: Mosaic reported 20.33M
     for (BM=256, CM=256, NW=256, r=5), i.e. ~75 per sub-tile row ≈ 10.7
-    per plane at r=5's 7 planes; 11 is the safety-rounded coefficient."""
+    per plane at r=5's 7 planes; 11 is the safety-rounded coefficient.
+
+    Wide rows carry the sibling pallas_bitlife calibration's hard rail:
+    every 512-row slab at NW=2048 is measured VMEM OOM there despite
+    passing a similar coefficient screen, and this kernel's screen
+    cannot predict those OOMs either — so bm is capped at 256 when
+    NW > 512 rather than trusting an unmeasured shape to compile
+    (ADVICE r2: pallas_bitltl.py:60)."""
     limit = int(15.25 * (1 << 20))
     coeff = 11 * _nplanes(radius)
     for bm in (512, 256, 128, 64, 32, 16, 8):
         if H % bm:
             continue
+        if bm > 256 and NW > 512:
+            continue  # measured-OOM regime in the sibling kernel
         dbuf = 2 * (bm + 2 * HALO) * NW * 4
         for cm in (256, 128, 64, 32, 16, 8):
             if cm > bm:
@@ -70,21 +91,31 @@ def _pick_blocks(H: int, NW: int, radius: int) -> Tuple[int, int] | None:
     return None
 
 
-def supports(shape: Tuple[int, int], rule: Rule) -> bool:
+def supports(shape: Tuple[int, int], rule: Rule, gens: int = 1) -> bool:
     H, W = shape
     return (
         W % WORD == 0
         and (W // WORD) % 128 == 0  # packed width must stay lane-aligned
         and 1 <= rule.radius <= 7
+        and 1 <= gens <= max_gens(rule.radius)
+        # dead-boundary halo rows must stay dead across in-VMEM
+        # generations — mirror pallas_ltl_step's own rejection so the
+        # capability check matches what the step accepts
+        and not (gens > 1 and 0 in rule.birth)
         and H >= HALO
         and _pick_blocks(H, W // WORD, rule.radius) is not None
     )
 
 
-def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int):
+def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int,
+                 gens: int = 1):
     periodic = boundary == "periodic"
     r = rule.radius
     nblocks = H // BM
+    if not 1 <= gens <= max_gens(r):
+        raise ValueError(
+            f"gens must be in 1..{max_gens(r)} for radius {r}, got {gens}"
+        )
 
     def _block_dmas(in_hbm, dbuf, sems, blk, slot):
         base = blk * BM
@@ -140,14 +171,14 @@ def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int):
                     (HALO, NW), dtype=jnp.uint32
                 )
 
-        def compute_rows(a: int, rows: int):
-            """Next state of slab rows [a, a+rows) (absolute slab idx)."""
-            # vertical sums: free static slab slices, one 1-bit ripple
-            # add per neighbor row
-            v: List[Plane] = [scratch[a : a + rows, :]]
+        def next_state(row_slice, rows):
+            """Next state of ``rows`` rows; ``row_slice(d)`` yields their
+            vertical neighbors at offset d ∈ [-r, r]."""
+            # vertical sums: one 1-bit ripple add per neighbor row
+            v: List[Plane] = [row_slice(0)]
             for d in range(1, r + 1):
-                v = bs_add(v, [scratch[a + d : a + rows + d, :]])
-                v = bs_add(v, [scratch[a - d : a + rows - d, :]])
+                v = bs_add(v, [row_slice(d)])
+                v = bs_add(v, [row_slice(-d)])
 
             lane = (
                 None if periodic
@@ -169,17 +200,61 @@ def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int):
                 total = bs_add(total, hshift(d))
                 total = bs_add(total, hshift(-d))
 
-            mid = scratch[a : a + rows, :]
+            mid = row_slice(0)
             zero = jnp.zeros((rows, NW), dtype=jnp.uint32)
             born = _in_intervals(total, rule.birth_intervals, 0, zero)
             stay = _in_intervals(total, rule.survive_intervals, 1, zero)
-            out_ref[a - HALO : a + rows - HALO, :] = (~mid & born) | (mid & stay)
+            return (~mid & born) | (mid & stay)
 
-        a = HALO
-        while a < HALO + BM:
-            rows = min(CM, HALO + BM - a)
-            compute_rows(a, rows)
-            a += rows
+        # Trapezoid over ``gens`` in-VMEM generations, each consuming r
+        # valid rows per side (pallas_bitlife's machinery at stride r).
+        # Intermediate generations update the slab in place in CM-row
+        # sub-tiles; the r rows above a sub-tile were overwritten by its
+        # predecessor, so their OLD values ride in ``saved`` (CM ≥ 8 > r
+        # guarantees the predecessor covered them).  The final generation
+        # reads scratch untouched-this-generation and writes out_ref.
+        lo, hi = 0, BM + 2 * HALO
+        for g in range(gens):
+            rem = gens - 1 - g
+            glo = max(lo + r, HALO - rem * r)
+            ghi = min(hi - r, HALO + BM + rem * r)
+            saved = None
+            a = glo
+            while a < ghi:
+                b = min(a + CM, ghi)
+                rows = b - a
+                if rem == 0:
+                    new = next_state(
+                        lambda d: scratch[a + d : b + d, :], rows
+                    )
+                    out_ref[a - HALO : b - HALO, :] = new
+                else:
+                    top = scratch[a - r : a, :] if saved is None else saved
+                    saved = scratch[b - r : b, :]  # old rows, read pre-write
+                    win = jnp.concatenate([top, scratch[a : b + r, :]], axis=0)
+                    new = next_state(
+                        lambda d: win[r + d : r + d + rows, :], rows
+                    )
+                    scratch[a:b, :] = new
+                a = b
+            if rem:
+                if not periodic:
+                    # rows beyond the grid edge are not real cells: re-kill
+                    # any "births" there after every in-VMEM generation
+                    if glo < HALO:
+                        @pl.when(i == 0)
+                        def _():
+                            scratch[glo:HALO, :] = jnp.zeros(
+                                (HALO - glo, NW), dtype=jnp.uint32
+                            )
+
+                    if ghi > HALO + BM:
+                        @pl.when(i == nblocks - 1)
+                        def _():
+                            scratch[HALO + BM : ghi, :] = jnp.zeros(
+                                (ghi - HALO - BM, NW), dtype=jnp.uint32
+                            )
+                lo, hi = glo, ghi
 
     return kernel
 
@@ -190,9 +265,11 @@ def pallas_ltl_step(
     boundary: str = "periodic",
     interpret: bool = False,
     blocks: Tuple[int, int] | None = None,
+    gens: int = 1,
 ) -> jax.Array:
-    """One radius-r generation on a packed (H, W/32) uint32 grid via the
-    fused bit-sliced kernel.  Requires ``supports((H, W), rule)``."""
+    """``gens`` radius-r generations on a packed (H, W/32) uint32 grid in
+    one HBM round-trip via the fused bit-sliced kernel.  Requires
+    ``supports((H, W), rule, gens)``."""
     H, NW = packed.shape
     picked = blocks or _pick_blocks(H, NW, rule.radius)
     if picked is None or rule.radius > 7:
@@ -200,7 +277,18 @@ def pallas_ltl_step(
             f"pallas_ltl_step cannot handle packed shape {packed.shape}"
         )
     BM, CM = picked
-    kernel = _make_kernel(rule, boundary, H, NW, BM, CM)
+    # explicit blocks= bypasses supports(): re-check the invariants that
+    # would otherwise surface as opaque Mosaic errors on real hardware
+    # (ADVICE r2: pallas_bitltl.py:196)
+    if H % BM or NW % 128:
+        raise ValueError(
+            f"blocks {picked} invalid for packed shape {packed.shape}: "
+            f"need H % BM == 0 and (W/32) % 128 == 0"
+        )
+    if gens > 1 and 0 in rule.birth:
+        # dead-boundary halo rows must stay dead across in-VMEM generations
+        raise ValueError("gens > 1 requires a rule without birth-on-0")
+    kernel = _make_kernel(rule, boundary, H, NW, BM, CM, gens)
     return pl.pallas_call(
         kernel,
         grid=(H // BM,),
@@ -216,20 +304,20 @@ def pallas_ltl_step(
 
 
 def make_pallas_ltl_stepper(
-    rule: Rule, boundary: str = "periodic", interpret: bool = False
+    rule: Rule, boundary: str = "periodic", interpret: bool = False,
+    gens: int = 1,
 ):
-    """evolve(packed, steps) — jitted scan with donated carry."""
-    import functools
+    """evolve(packed, steps) running ``gens`` generations per kernel pass
+    (temporal blocking); jitted with donated carry, remainder steps served
+    by shallower passes (the segmenting contract shared with
+    pallas_bitlife's stepper)."""
+    from mpi_tpu.utils.segmenting import segmented_evolve
 
-    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=(0,))
-    def evolve(packed, steps: int):
-        out, _ = lax.scan(
-            lambda g, _: (
-                pallas_ltl_step(g, rule, boundary, interpret=interpret),
-                None,
-            ),
-            packed, None, length=steps,
-        )
-        return out
+    def make_local(k):
+        def local(p):
+            return pallas_ltl_step(p, rule, boundary, interpret=interpret,
+                                   gens=k)
 
-    return evolve
+        return local
+
+    return segmented_evolve(make_local, gens)

@@ -2,10 +2,12 @@
 
 from mpi_tpu.parallel.mesh import make_mesh
 from mpi_tpu.parallel.halo import exchange_halo
+from mpi_tpu.parallel.policy import choose_comm_policy
 from mpi_tpu.parallel.step import (
     make_sharded_stepper,
     sharded_init,
     make_sharded_bit_stepper,
+    make_sharded_ltl_stepper,
     sharded_bit_init,
     sharded_unpack,
 )
@@ -13,9 +15,11 @@ from mpi_tpu.parallel.step import (
 __all__ = [
     "make_mesh",
     "exchange_halo",
+    "choose_comm_policy",
     "make_sharded_stepper",
     "sharded_init",
     "make_sharded_bit_stepper",
+    "make_sharded_ltl_stepper",
     "sharded_bit_init",
     "sharded_unpack",
 ]

@@ -5,6 +5,17 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# A dead TPU tunnel fails (or hangs) backend init; probe first (subprocess
+# + timeout) and smoke on CPU when the chip is unreachable.  An explicit
+# MPI_TPU_PLATFORM wins.
+if [ -z "${MPI_TPU_PLATFORM:-}" ]; then
+  PLAT=$(python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform() or '')" || true)
+  if [ "$PLAT" != "tpu" ]; then
+    echo "run.sh: TPU unreachable (probe='${PLAT}'); smoking on CPU" >&2
+    export MPI_TPU_PLATFORM=cpu
+  fi
+fi
+
 make -C mpi_tpu/backends/native
 
 OUT=$(mktemp -d)

@@ -61,7 +61,10 @@ def test_bench_size_fallback(monkeypatch, capsys):
     monkeypatch.setattr(bench, "run_sub", fake)
     out = run_main(capsys)
     assert out["size"] == bench.SIZES[1]
-    assert "fell back" in out["degraded"]
+    # a real TPU number is never "degraded" (VERDICT r2 item 1); the
+    # missed flagship size is a note instead
+    assert "degraded" not in out
+    assert "flagship" in out["note"]
 
 
 def test_bench_tpu_unreachable_cpu_fallback(monkeypatch, capsys):
@@ -200,7 +203,8 @@ def test_bench_endpoint_recovery_retry(monkeypatch, capsys):
         if argv[0] == "--probe":
             return {"platform": "tpu"}, "ok"
         calls.append(tuple(argv))
-        if len(calls) <= bench.ATTEMPTS_PER_SIZE * len(bench.SIZES):
+        # bank attempt + full ladder (incl. the re-entered bank size)
+        if len(calls) <= 1 + bench.ATTEMPTS_PER_SIZE * len(bench.SIZES):
             return None, "UNAVAILABLE: remote_compile refused"
         return {"value": 2.0e12, "platform": "tpu",
                 "size": int(argv[1]), "gens": int(argv[3])}, "ok"
@@ -227,7 +231,8 @@ def test_bench_no_recovery_retry_after_ladder_timeouts(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "run_sub", fake)
     out = run_main(capsys)
-    assert len(calls) == bench.ATTEMPTS_PER_SIZE * len(bench.SIZES)
+    # bank attempt + full ladder (bank size re-enters after bank failure)
+    assert len(calls) == 1 + bench.ATTEMPTS_PER_SIZE * len(bench.SIZES)
     assert out["platform"] == "cpu"
 
 
@@ -268,8 +273,9 @@ def test_bench_happy_path_records_verified(monkeypatch, capsys, tmp_path):
     monkeypatch.setattr(bench, "run_sub", fake)
     out = run_main(capsys)
     assert "last_verified_tpu" not in out
-    rec = _json.loads((tmp_path / "verified.json").read_text())
-    assert rec["value"] == 2.0e12 and rec["platform"] == "tpu"
+    recs = _json.loads((tmp_path / "verified.json").read_text())["records"]
+    assert recs[str(bench.SIZES[0])]["value"] == 2.0e12
+    assert recs[str(bench.BANK_SIZE)]["platform"] == "tpu"  # banked rung
 
     # a later, slower undegraded run must NOT overwrite the better record
     def slower(argv, timeout, cpu=False):
@@ -280,8 +286,8 @@ def test_bench_happy_path_records_verified(monkeypatch, capsys, tmp_path):
 
     monkeypatch.setattr(bench, "run_sub", slower)
     run_main(capsys)
-    rec = _json.loads((tmp_path / "verified.json").read_text())
-    assert rec["value"] == 2.0e12
+    recs = _json.loads((tmp_path / "verified.json").read_text())["records"]
+    assert recs[str(bench.SIZES[0])]["value"] == 2.0e12
 
 
 def test_bench_corrupt_verified_record_never_breaks_a_run(monkeypatch,
@@ -299,8 +305,9 @@ def test_bench_corrupt_verified_record_never_breaks_a_run(monkeypatch,
     monkeypatch.setattr(bench, "run_sub", good)
     out = run_main(capsys)
     assert "error" not in out and out["value"] == 1.5e12
-    rec = json.loads((tmp_path / "verified.json").read_text())
-    assert rec["value"] == 1.5e12  # fresh record replaced the corrupt one
+    recs = json.loads((tmp_path / "verified.json").read_text())["records"]
+    # fresh record replaced the corrupt one
+    assert recs[str(bench.SIZES[0])]["value"] == 1.5e12
 
     (tmp_path / "verified.json").write_text("{trunc")
     monkeypatch.setattr(
@@ -322,3 +329,121 @@ def test_bench_crash_guard_attaches_verified(monkeypatch, capsys, tmp_path):
     out = run_main(capsys)
     assert "bench harness error" in out["error"]
     assert out["last_verified_tpu"]["value"] == 2.0e12
+
+
+def test_bench_bank_survives_failed_climb(monkeypatch, capsys, tmp_path):
+    # the tunnel dies after the banked rung: the round still reports an
+    # undegraded platform=tpu number from THIS capture, and the banked
+    # record is on disk (VERDICT r2 item 1's core scenario)
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size = int(argv[1])
+        if size == bench.BANK_SIZE:
+            return {"value": 2.3e12, "platform": "tpu",
+                    "size": size, "gens": int(argv[3])}, "ok"
+        return None, "timeout after 1200s"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["platform"] == "tpu" and out["size"] == bench.BANK_SIZE
+    assert "degraded" not in out
+    assert "flagship" in out["note"]
+    recs = json.loads((tmp_path / "verified.json").read_text())["records"]
+    assert recs[str(bench.BANK_SIZE)]["value"] == 2.3e12
+
+
+def test_bench_bank_rung_never_shadows_flagship_record(monkeypatch, capsys,
+                                                       tmp_path):
+    # 8192^2 runs intrinsically faster than 65536^2 (width penalty): a
+    # fast banked rung must not replace the flagship evidence that
+    # degraded rounds attach
+    flagship = {"value": 1.95e12, "platform": "tpu", "size": 65536}
+    (tmp_path / "verified.json").write_text(
+        json.dumps({"records": {"65536": flagship}}))
+
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size = int(argv[1])
+        if size == bench.BANK_SIZE:
+            return {"value": 2.5e12, "platform": "tpu",
+                    "size": size, "gens": int(argv[3])}, "ok"
+        return None, "timeout after 1200s"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    # the attached flagship evidence is still the 65536^2 record
+    assert out["last_verified_tpu"]["size"] == 65536
+    recs = json.loads((tmp_path / "verified.json").read_text())["records"]
+    assert recs["65536"]["value"] == 1.95e12
+    assert recs[str(bench.BANK_SIZE)]["value"] == 2.5e12
+
+
+def test_bench_persist_failure_leaves_trace(monkeypatch, capsys, tmp_path):
+    # ADVICE r2 (bench.py:214): a suppressed persistence failure must
+    # land in the attempt history, not vanish
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        return {"value": 2.0e12, "platform": "tpu",
+                "size": int(argv[1]), "gens": int(argv[3])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+
+    def deny(*a, **k):
+        raise OSError("read-only filesystem")
+
+    monkeypatch.setattr(bench.os, "replace", deny)
+    run_main(capsys)
+    art = json.loads((tmp_path / "bench.json").read_text())
+    assert any("persist-error" in a for a in art["attempts"])
+
+
+def test_bench_verified_record_stays_clean(monkeypatch, capsys, tmp_path):
+    # the persisted record must never nest prior evidence or carry this
+    # capture's note/degraded fields (code-review r3 finding)
+    prior = {"value": 1.95e12, "platform": "tpu", "size": 65536}
+    (tmp_path / "verified.json").write_text(
+        json.dumps({"records": {"65536": prior}}))
+
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size = int(argv[1])
+        if size == 16384:
+            return {"value": 2.2e12, "platform": "tpu",
+                    "size": size, "gens": int(argv[3])}, "ok"
+        return None, "timeout after 900s"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["size"] == 16384 and "note" in out
+    assert out["last_verified_tpu"]["size"] == 65536
+    rec = json.loads((tmp_path / "verified.json").read_text())["records"]["16384"]
+    assert "last_verified_tpu" not in rec and "note" not in rec
+    assert rec["value"] == 2.2e12
+
+
+def test_bench_first_ever_bank_not_labeled_prior(monkeypatch, capsys,
+                                                 tmp_path):
+    # fresh checkout (no verified file): a banked rung + failed climb
+    # must NOT attach the run's own record as "prior" evidence, and the
+    # banked record must carry the full measurement schema
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size = int(argv[1])
+        if size == bench.BANK_SIZE:
+            return {"value": 2.3e12, "platform": "tpu",
+                    "size": size, "gens": int(argv[3])}, "ok"
+        return None, "timeout after 1200s"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["size"] == bench.BANK_SIZE
+    assert "last_verified_tpu" not in out  # nothing genuinely prior
+    rec = json.loads((tmp_path / "verified.json").read_text())
+    banked = rec["records"][str(bench.BANK_SIZE)]
+    for k in ("metric", "unit", "vs_baseline", "value", "platform"):
+        assert k in banked, f"banked record missing {k}"

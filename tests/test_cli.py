@@ -298,3 +298,38 @@ def test_batch_script_runs(tmp_path):
     final = golio.assemble(str(tmp_path), "batch-64x64-8-s3", 8)
     ref = evolve_np(init_tile_np(64, 64, seed=3), 8, LIFE, "periodic")
     np.testing.assert_array_equal(final, ref)
+
+
+def test_cli_golp_resume_roundtrip(tmp_path):
+    # packed snapshots end-to-end (VERDICT r2 item 3): run with
+    # --snapshot-format golp, resume from the packed checkpoint, and the
+    # continuation matches a text-format full run bit-for-bit
+    run_cli(tmp_path, "full", "serial")
+    rc = main(["32", "32", "8", "8", "--backend", "serial", "--save",
+               "--snapshot-format", "golp", "--out-dir", str(tmp_path),
+               "--name", "phalf", "--seed", "5", "--quiet"])
+    assert rc == 0
+    assert os.path.exists(golio.tile_path_packed(str(tmp_path), "phalf", 8, 0))
+    assert not os.path.exists(golio.tile_path(str(tmp_path), "phalf", 8, 0))
+    rc = main(["32", "32", "8", "8", "--backend", "tpu", "--save",
+               "--snapshot-format", "golp", "--out-dir", str(tmp_path),
+               "--resume", "phalf@8", "--quiet"])
+    assert rc == 0
+    np.testing.assert_array_equal(
+        golio.assemble(str(tmp_path), "phalf", 16),
+        golio.assemble(str(tmp_path), "full", 16),
+    )
+
+
+def test_visualizer_reads_golp(tmp_path, capsys):
+    run_cli(tmp_path, "vizp", "serial", extra=("--snapshot-format", "golp"))
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "golvizp", os.path.join(os.path.dirname(__file__), "..", "tools",
+                                "gol_visualization.py"))
+    viz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(viz)
+    master = golio.master_path(str(tmp_path), "vizp")
+    assert viz.main([master, "--format", "ascii"]) == 0
+    assert "iteration 16" in capsys.readouterr().out

@@ -284,3 +284,111 @@ def test_cpp_ltl_small_rows_fall_back_to_byte_engine():
         evolve_cpp(g, 3, BOSCO, "periodic"),
         evolve_np(g, 3, BOSCO, "periodic"),
     )
+
+
+def test_gol_native_detailed_report_layout(tmp_path):
+    # VERDICT r2 missing #2: the native binary must emit _detailed.out
+    # with the same layout as the Python CLI (utils/timing.py)
+    import io
+
+    from mpi_tpu.utils.timing import PhaseTimer, write_reports
+
+    r = _run_native(tmp_path, "32", "32", "8", "8", "nat", "1",
+                    "--workers", "4", "--seed", "3", "--name", "n")
+    assert r.returncode == 0, r.stderr
+    nat = (tmp_path / "nat_detailed.out").read_text().splitlines()
+    t = PhaseTimer()
+    t.setup_done()
+    t.finish()
+    write_reports("py", t, 32, 32, 4, out_dir=str(tmp_path))
+    py = (tmp_path / "py_detailed.out").read_text().splitlines()
+    assert len(nat) == len(py)
+    import re
+
+    strip = lambda s: re.sub(r"\d+", "#", s)
+    assert [strip(l) for l in nat] == [strip(l) for l in py]
+    # avg/sum come from measured per-worker durations, not single*p
+    csv = (tmp_path / "nat_compact.csv").read_text().splitlines()
+    row = csv[-1].split(",")
+    nos_single, nos_avg, nos_sum = int(row[6]), int(row[7]), int(row[8])
+    assert nos_sum >= nos_avg * 4 - 4  # sum over 4 measured workers
+    assert nos_avg > 0
+
+
+def test_gol_native_resume_roundtrip(tmp_path):
+    # run to 16 == run to 8 then --resume half@8, in both tile formats
+    for fmt in ("gol", "golp"):
+        d = tmp_path / fmt
+        d.mkdir()
+        r = _run_native(d, "32", "32", "8", "16", "--save", "--seed", "5",
+                        "--name", "full")
+        assert r.returncode == 0, r.stderr
+        r = _run_native(d, "32", "32", "8", "8", "--save", "--seed", "5",
+                        "--name", "half", "--snapshot-format", fmt)
+        assert r.returncode == 0, r.stderr
+        r = _run_native(d, "32", "32", "8", "8", "--save",
+                        "--resume", "half@8")
+        assert r.returncode == 0, r.stderr
+        from mpi_tpu import golio
+
+        np.testing.assert_array_equal(
+            golio.assemble(str(d), "half", 16),
+            golio.assemble(str(d), "full", 16),
+        )
+        # resumed master extends the iteration count
+        assert golio.read_master(golio.master_path(str(d), "half"))[3] == 16
+
+
+def test_gol_native_resume_python_snapshot(tmp_path):
+    # cross-backend: a packed snapshot written by the Python CLI resumes
+    # in the native binary (and vice versa the .golp parity is covered by
+    # test_cli_golp_resume_roundtrip)
+    from mpi_tpu import golio
+    from mpi_tpu.cli import main
+
+    rc = main(["32", "32", "8", "8", "--backend", "serial", "--save",
+               "--snapshot-format", "golp", "--out-dir", str(tmp_path),
+               "--name", "py", "--seed", "5", "--quiet"])
+    assert rc == 0
+    r = _run_native(tmp_path, "32", "32", "8", "8", "--save",
+                    "--resume", "py@8")
+    assert r.returncode == 0, r.stderr
+    rc = main(["32", "32", "8", "16", "--backend", "serial", "--save",
+               "--out-dir", str(tmp_path), "--name", "ref", "--seed", "5",
+               "--quiet"])
+    assert rc == 0
+    np.testing.assert_array_equal(
+        golio.assemble(str(tmp_path), "py", 16),
+        golio.assemble(str(tmp_path), "ref", 16),
+    )
+
+
+def test_gol_native_strict(tmp_path):
+    # the reference's validation rules (main.cpp:195) from the native CLI
+    r = _run_native(tmp_path, "32", "16", "8", "4", "--strict")
+    assert r.returncode == 2 and "square" in r.stderr
+    r = _run_native(tmp_path, "32", "32", "8", "4", "--strict",
+                    "--workers", "2")
+    assert r.returncode == 2 and "perfect square" in r.stderr
+    r = _run_native(tmp_path, "8", "8", "8", "4", "--strict",
+                    "--workers", "16")  # 4x4 mesh, 2-cell tiles
+    assert r.returncode == 2 and ">= 4" in r.stderr
+    r = _run_native(tmp_path, "32", "32", "8", "4", "--strict",
+                    "--workers", "4", "--name", "ok")
+    assert r.returncode == 0, r.stderr
+
+
+def test_gol_native_resume_errors(tmp_path):
+    r = _run_native(tmp_path, "32", "32", "8", "4", "--resume", "nope")
+    assert r.returncode == 2 and "NAME@ITER" in r.stderr
+    r = _run_native(tmp_path, "32", "32", "8", "4", "--resume", "ghost@8")
+    assert r.returncode == 2 and "cannot resume" in r.stderr
+    # master exists but tiles missing at that iteration
+    r = _run_native(tmp_path, "32", "32", "8", "4", "--save", "--name", "m",
+                    "--seed", "1")
+    assert r.returncode == 0
+    r = _run_native(tmp_path, "32", "32", "8", "4", "--resume", "m@999")
+    assert r.returncode == 2 and "no tile files" in r.stderr
+    # grid-shape mismatch
+    r = _run_native(tmp_path, "64", "64", "8", "4", "--resume", "m@4")
+    assert r.returncode == 2 and "asks for" in r.stderr

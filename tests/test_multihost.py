@@ -30,29 +30,38 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(pid: int, port: int, out_dir: str, argv=None) -> subprocess.Popen:
+def _launch(pid: int, port: int, out_dir: str, argv=None, n_procs: int = 2,
+            local_devices: int = 2) -> subprocess.Popen:
     env = dict(os.environ)
-    # two virtual CPU devices per process → a 4-device global mesh; the
+    # ``local_devices`` virtual CPU devices per process; the
     # MPI_TPU_PLATFORM hook beats the ambient sitecustomize platform pin
     env["MPI_TPU_PLATFORM"] = "cpu"
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
     env["PYTHONPATH"] = REPO
     argv = argv if argv is not None else ["32", "32", "8", "16", "mh", "1"]
     return subprocess.Popen(
         [sys.executable, "-m", "mpi_tpu.cli", *argv,
          "--backend", "tpu", "--save", "--multihost",
          "--coordinator", f"localhost:{port}",
-         "--num-processes", "2", "--process-id", str(pid),
+         "--num-processes", str(n_procs), "--process-id", str(pid),
          "--seed", "5", "--out-dir", out_dir, "--quiet"],
         env=env, cwd=REPO,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
 
 
-def _run_group(out_dir: str, argv=None) -> None:
+def _run_group(out_dir: str, argv=None, n_procs: int = 2,
+               devices_per_proc=None) -> None:
+    """devices_per_proc: per-pid local device counts (default 2 each) —
+    unequal counts model uneven hosts."""
     port = _free_port()
-    procs = [_launch(pid, port, out_dir, argv) for pid in (0, 1)]
+    devs = devices_per_proc or [2] * n_procs
+    procs = [
+        _launch(pid, port, out_dir, argv, n_procs=n_procs,
+                local_devices=devs[pid])
+        for pid in range(n_procs)
+    ]
     outs = []
     # collect everything before asserting: an early assert would leak the
     # other process (blocked on the dead coordinator) into the session
@@ -147,4 +156,42 @@ def test_two_process_multihost_ltl_engine(tmp_path):
     name = "run-64x256-16-s5"
     final = golio.assemble(str(tmp_path), name, 16)
     ref = evolve_np(init_tile_np(64, 256, seed=5), 16, rule, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_four_process_group(tmp_path):
+    # VERDICT r2 item 7: a 4-process group, one device per process (the
+    # 4-host pod-slice shape) — process-group init, per-host single-shard
+    # dumps, and reassembly must all hold beyond the 2-process case
+    _run_group(str(tmp_path), ["32", "32", "16", "16"], n_procs=4,
+               devices_per_proc=[1, 1, 1, 1])
+    name = "run-32x32-16-s5"
+    rows, cols, _, _, tile_writers = golio.read_master(
+        golio.master_path(str(tmp_path), name))
+    assert (rows, cols, tile_writers) == (32, 32, 4)
+    final = golio.assemble(str(tmp_path), name, 16)
+    ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)
+
+
+def test_uneven_host_ltl_resume(tmp_path):
+    # VERDICT r2 item 7: an LtL resume where the writing and resuming
+    # decompositions DISAGREE — snapshots written on a (1,4) mesh (4
+    # column-strip tiles), resumed on a (2,2) mesh, so every resuming
+    # host's shard regions cut across the written tile boundaries and
+    # golio.assemble_region must stitch partial tiles per host.  (Truly
+    # unequal per-process device counts are rejected by the CPU
+    # distributed backend itself — global device views diverge — so
+    # unevenness is modeled at the decomposition level, which is also
+    # what a pod-slice shape change at resume time produces.)
+    from mpi_tpu.models.rules import rule_from_name
+
+    rule = rule_from_name("R2,B10-13,S8-12")
+    base = ["64", "512", "8", "8", "--rule", "R2,B10-13,S8-12",
+            "--name", "uneven"]  # 512/4 and 512/2 cols both word-aligned
+    _run_group(str(tmp_path), base + ["--mesh", "1x4"])
+    _run_group(str(tmp_path), base + ["--mesh", "2x2",
+                                      "--resume", "uneven@8"])
+    final = golio.assemble(str(tmp_path), "uneven", 16)
+    ref = evolve_np(init_tile_np(64, 512, seed=5), 16, rule, "periodic")
     np.testing.assert_array_equal(final, ref)

@@ -1,0 +1,85 @@
+"""--comm-every auto policy (VERDICT r2 item 8): table + dispatch tests;
+latency thresholds are placeholders pending multi-chip hardware."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu.models.rules import LIFE, BOSCO, rule_from_name
+from mpi_tpu.parallel.policy import (
+    choose_comm_policy,
+    probe_collective_latency_us,
+    resolve_auto,
+)
+
+
+def test_single_device_keeps_todays_behavior():
+    assert choose_comm_policy(1, LIFE, 4096, 4096, 9999.0) == (1, False)
+    assert choose_comm_policy(1, LIFE, 4096, 4096, 9999.0,
+                              overlap_requested=True) == (1, True)
+
+
+def test_latency_table_monotone():
+    ks = [choose_comm_policy(8, LIFE, 8192, 8192, us)[0]
+          for us in (1.0, 50.0, 300.0, 5000.0)]
+    assert ks == sorted(ks) and ks[0] == 1 and ks[-1] == 8
+
+
+def test_engine_and_fringe_clamps():
+    # radius-5: K*r <= 31 -> K <= 6; fringe: tile 128 -> K <= 128/(8*5)=3
+    k, _ = choose_comm_policy(8, BOSCO, 128, 128, 1e6)
+    assert k == 3
+    # tiny tiles: fringe clamp floors at 1
+    k, _ = choose_comm_policy(8, BOSCO, 40, 40, 1e6)
+    assert k == 1
+    # radius-1 engine bound is 16
+    k, _ = choose_comm_policy(8, LIFE, 1 << 20, 1 << 20, 1e6)
+    assert k == 8  # table max, within the 16 bound
+
+
+def test_birth_on_zero_disables_deep_halos():
+    b0 = rule_from_name("B03/S23")  # births on 0 neighbors
+    assert choose_comm_policy(8, b0, 8192, 8192, 1e6)[0] == 1
+
+
+def test_overlap_requires_fitting_bands():
+    r2 = rule_from_name("R2,B10-13,S8-12")
+    _, ov = choose_comm_policy(8, r2, 8192, 8192, 300.0)
+    assert ov
+    _, ov = choose_comm_policy(8, r2, 8192, 32, 300.0)  # cols < 64
+    assert not ov
+
+
+def test_probe_and_resolve_on_virtual_mesh():
+    from mpi_tpu.config import GolConfig
+    from mpi_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((2, 4))
+    us = probe_collective_latency_us(mesh)
+    assert us > 0
+    cfg = GolConfig(rows=256, cols=256, steps=1)
+    k, ov = resolve_auto(cfg, (2, 4), mesh=mesh)
+    assert 1 <= k <= 16 and isinstance(ov, bool)
+    # explicit latency bypasses the probe (table pin)
+    assert resolve_auto(cfg, (2, 4), latency_us=50.0)[0] == 2
+
+
+def test_cli_comm_every_auto(tmp_path):
+    from mpi_tpu import golio
+    from mpi_tpu.backends.serial_np import evolve_np
+    from mpi_tpu.cli import main
+    from mpi_tpu.utils.hashinit import init_tile_np
+
+    rc = main(["64", "256", "8", "8", "--backend", "tpu", "--save",
+               "--comm-every", "auto", "--out-dir", str(tmp_path),
+               "--name", "auto", "--seed", "5", "--quiet"])
+    assert rc == 0
+    np.testing.assert_array_equal(
+        golio.assemble(str(tmp_path), "auto", 8),
+        evolve_np(init_tile_np(64, 256, seed=5), 8, LIFE, "periodic"),
+    )
+    rc = main(["64", "256", "8", "8", "--backend", "serial",
+               "--comm-every", "auto", "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2  # tpu-only
+    rc = main(["64", "256", "8", "8", "--backend", "tpu",
+               "--comm-every", "nope", "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 2

@@ -406,7 +406,12 @@ def _main_inner():
     #     because it is strictly keep-the-max (a compile failure, timeout,
     #     or slower result leaves the gens=8 number untouched) and a
     #     future kernel may tip the balance.
-    if result is not None and result.get("platform") == "tpu":
+    if result is not None and result.get("platform") == "tpu" and (
+        result is not bank or not ladder_timed_out
+    ):
+        # (skipped when the only result is the banked rung AND the ladder
+        # burned hard timeouts — the tunnel died after the bank, and one
+        # more long doomed attempt contradicts 3a's own rationale)
         res, note = run_sub(
             ["--child", str(result["size"]),
              str(STEPS_BY_SIZE[result["size"]]), str(DEEP_GENS)],

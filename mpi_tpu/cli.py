@@ -62,7 +62,7 @@ def build_parser() -> argparse.ArgumentParser:
                    "binary (1 bit/cell — a 65536^2 snapshot drops from "
                    "~8.6 GB to ~537 MB); auto picks text for small tiles "
                    "and golp above %d cells/tile. Resume and the "
-                   "visualizer read both." % (1 << 24))
+                   "visualizer read both." % golio.GOLP_THRESHOLD)
     p.add_argument("--out-dir", default=".")
     p.add_argument("--mesh", default=None, metavar="IxJ",
                    help="TPU device mesh shape, e.g. 2x4 (default: auto)")

@@ -122,6 +122,19 @@ def resolve_auto(
     n = mi * mj
     if n > 1 and latency_us is None:
         latency_us = probe_collective_latency_us(mesh)
+        import jax
+
+        if jax.process_count() > 1:
+            # every process MUST resolve the same policy: differing K
+            # across hosts would compile mismatched collective programs
+            # (unpaired ppermutes → hang).  Per-host medians can straddle
+            # a table threshold, so process 0's measurement is broadcast
+            # and used by all.
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            latency_us = float(multihost_utils.broadcast_one_to_all(
+                np.float64(latency_us)))
     return choose_comm_policy(
         n, config.rule, config.rows // mi, config.cols // mj,
         latency_us if latency_us is not None else 0.0,

@@ -447,3 +447,26 @@ def test_bench_first_ever_bank_not_labeled_prior(monkeypatch, capsys,
     banked = rec["records"][str(bench.BANK_SIZE)]
     for k in ("metric", "unit", "vs_baseline", "value", "platform"):
         assert k in banked, f"banked record missing {k}"
+
+
+def test_bench_no_deep_gens_on_dead_tunnel_bank_only(monkeypatch, capsys):
+    # bank succeeded, then every ladder attempt burned a hard timeout:
+    # the opportunistic deep-gens pass must NOT launch one more doomed
+    # long subprocess (code-review r3 finding)
+    calls = []
+
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu"}, "ok"
+        size, gens = int(argv[1]), int(argv[3])
+        calls.append((size, gens))
+        if size == bench.BANK_SIZE and gens == bench.GENS:
+            return {"value": 2.3e12, "platform": "tpu",
+                    "size": size, "gens": gens}, "ok"
+        return None, f"timeout after {timeout:.0f}s"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["size"] == bench.BANK_SIZE
+    assert all(g != bench.DEEP_GENS for _, g in calls), \
+        "deep-gens attempt fired against a dead tunnel"

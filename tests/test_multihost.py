@@ -195,3 +195,16 @@ def test_uneven_host_ltl_resume(tmp_path):
     final = golio.assemble(str(tmp_path), "uneven", 16)
     ref = evolve_np(init_tile_np(64, 512, seed=5), 16, rule, "periodic")
     np.testing.assert_array_equal(final, ref)
+
+
+def test_multihost_comm_every_auto_agrees(tmp_path):
+    # --comm-every auto across a process group: per-host latency medians
+    # could straddle a policy threshold, so process 0's measurement is
+    # broadcast — all hosts must compile the SAME collective program
+    # (divergent K would hang) and the result must match the oracle
+    _run_group(str(tmp_path),
+               ["64", "256", "16", "16", "--comm-every", "auto"])
+    name = "run-64x256-16-s5"
+    final = golio.assemble(str(tmp_path), name, 16)
+    ref = evolve_np(init_tile_np(64, 256, seed=5), 16, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Measure LtL Pallas temporal blocking on hardware (VERDICT r2 item 4).
+
+One JSON row per (radius, gens) point at 16384², each in its own
+subprocess (scan_common harness): r=2 at gens 1/2/4, r=3 and r=4 at
+gens 1/2, plus the r=5 gens=1 anchor.  The question is empirical —
+the r=5 kernel sits at/over the measured VPU chain roof
+(perf/roofline.json) so blocking cannot help it, but shallower radii
+have fewer ops/cell and therefore bandwidth headroom that gens>1 may
+convert into throughput.  Keep deeper gens in the dispatch only where
+a row here wins.
+
+    python tools/ltl_gens_ladder.py --out perf/ltl_gens_ladder.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SIDE = 16384
+# (radius, gens, cell budget per timed call) — budget / SIDE^2 = steps
+POINTS = (
+    (2, 1, 8e11),
+    (2, 2, 8e11),
+    (2, 4, 8e11),
+    (3, 1, 4e11),
+    (3, 2, 4e11),
+    (4, 1, 4e11),
+    (4, 2, 4e11),
+    (5, 1, 8e11),  # Bosco anchor: gens=1 is this radius's only depth
+)
+
+# one birth-on->0 rule per radius so every point admits gens > 1
+RULES = {
+    2: "R2,B10-13,S8-12",
+    3: "R3,B20-25,S18-30",
+    4: "R4,B35-45,S30-50",
+    5: "bosco",
+}
+
+
+def child(radius: int, gens: int, budget: float) -> None:
+    import jax
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+
+    from mpi_tpu.models.rules import rule_from_name
+    from mpi_tpu.ops.bitlife import init_packed
+    from mpi_tpu.ops.pallas_bitltl import pallas_ltl_step, supports
+    from scan_common import measure_scan_popcount, steps_for_budget
+
+    if jax.devices()[0].platform != "tpu":
+        raise RuntimeError("ltl gens ladder needs the real chip")
+
+    rule = rule_from_name(RULES[radius])
+    assert supports((SIDE, SIDE), rule, gens=gens)
+    steps = steps_for_budget(budget, SIDE * SIDE, gens)
+
+    def one(p):
+        return pallas_ltl_step(p, rule, "periodic", gens=gens)
+
+    grid = init_packed(SIDE, SIDE, seed=1)
+    compile_s, best = measure_scan_popcount(
+        one, grid, steps // gens, SIDE * SIDE * steps, packed=True
+    )
+    print(json.dumps({
+        "engine": f"ltl-r{radius}-g{gens}", "radius": radius, "gens": gens,
+        "side": SIDE, "steps": steps,
+        "gcells_per_s": round(best / 1e9, 1),
+        "compile_s": round(compile_s, 1),
+    }))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--out", default="perf/ltl_gens_ladder.json")
+    args = p.parse_args(argv)
+
+    from scan_common import require_tpu, run_child, write_out
+
+    if not require_tpu():
+        return 1
+
+    results = []
+    for radius, gens, budget in POINTS:
+        res = run_child(__file__, (radius, gens, budget), args.timeout)
+        if "error" in res:
+            res = {"engine": f"ltl-r{radius}-g{gens}", **res}
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        write_out(args.out, results)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), int(sys.argv[3]), float(sys.argv[4]))
+        sys.exit(0)
+    sys.exit(main())

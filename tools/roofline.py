@@ -199,7 +199,7 @@ def main() -> int:
 
     payload = {"roof_ops_per_s": args.roof, "rows": rows,
                "note": "see tools/roofline.py docstring for caveats"}
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=1)
 

@@ -222,6 +222,18 @@ void write_tiles(const std::string& dir, const std::string& name, long iter,
             }
         }
     }
+    // Prune stale higher-pid tiles left by an earlier wider run at this
+    // iteration (golio.remove_stale_tiles' discipline): without this, a
+    // rewrite with fewer workers leaves old tiles that resume/assemble
+    // would silently mix in.  Every run writes contiguous pids 0..P-1,
+    // so scanning upward from this run's count until a gap is complete.
+    for (int pid = ti * tj;; ++pid) {
+        std::string base = dir + "/" + name + "_" + std::to_string(iter) +
+                           "_" + std::to_string(pid);
+        bool had_text = std::remove((base + ".gol").c_str()) == 0;
+        bool had_packed = std::remove((base + ".golp").c_str()) == 0;
+        if (!had_text && !had_packed) break;
+    }
 }
 
 // Read one snapshot tile (either format) into the global grid; returns

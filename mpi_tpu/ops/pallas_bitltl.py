@@ -43,7 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from mpi_tpu.models.rules import Rule
 from mpi_tpu.ops.bitlife import WORD
-from mpi_tpu.ops.bitltl import Plane, bs_add, make_hshift, _in_intervals
+from mpi_tpu.ops.bitltl import Plane, bs_sum, make_hshift, _in_intervals
 
 HALO = 8  # DMA row slices must be 8-sublane aligned; covers r <= 7
 
@@ -174,11 +174,12 @@ def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int,
         def next_state(row_slice, rows):
             """Next state of ``rows`` rows; ``row_slice(d)`` yields their
             vertical neighbors at offset d ∈ [-r, r]."""
-            # vertical sums: one 1-bit ripple add per neighbor row
-            v: List[Plane] = [row_slice(0)]
-            for d in range(1, r + 1):
-                v = bs_add(v, [row_slice(d)])
-                v = bs_add(v, [row_slice(-d)])
+            # vertical sums: carry-save sum of the 2r+1 neighbor rows
+            v: List[Plane] = bs_sum(
+                [[row_slice(0)]]
+                + [[row_slice(d)] for d in range(1, r + 1)]
+                + [[row_slice(-d)] for d in range(1, r + 1)]
+            )
 
             lane = (
                 None if periodic
@@ -195,10 +196,11 @@ def _make_kernel(rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int,
 
             hshift = make_hshift(v, word_roll)
 
-            total: List[Plane] = list(v)
-            for d in range(1, r + 1):
-                total = bs_add(total, hshift(d))
-                total = bs_add(total, hshift(-d))
+            total: List[Plane] = bs_sum(
+                [list(v)]
+                + [hshift(d) for d in range(1, r + 1)]
+                + [hshift(-d) for d in range(1, r + 1)]
+            )
 
             mid = row_slice(0)
             zero = jnp.zeros((rows, NW), dtype=jnp.uint32)

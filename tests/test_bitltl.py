@@ -459,3 +459,27 @@ def test_run_tpu_single_device_ltl_comm_every_uses_fused_gens(monkeypatch):
     np.testing.assert_array_equal(
         out, evolve_np(init_tile_np(32, 4096, seed=5), 8, R2, "periodic")
     )
+
+
+def test_bs_sum_matches_integer_sums():
+    # carry-save (Wallace) reduction vs plain integer arithmetic: many
+    # addends, mixed plane counts, None planes included
+    from mpi_tpu.ops.bitltl import bs_sum
+
+    rng = np.random.default_rng(11)
+    vals = [rng.integers(0, 30, size=64, dtype=np.uint32) for _ in range(11)]
+    nums = []
+    for v in vals:
+        planes = []
+        for k in range(5):
+            bits = ((v >> k) & 1).astype(np.uint32).reshape(1, 64)
+            # exercise the constant-0 (None) plane convention
+            planes.append(None if not bits.any() else jnp.asarray(bits))
+        nums.append(planes)
+    s = bs_sum(nums)
+    got = sum(
+        (np.asarray(p).astype(np.uint64) << k)
+        for k, p in enumerate(s) if p is not None
+    )
+    np.testing.assert_array_equal(
+        got.ravel(), sum(v.astype(np.uint64) for v in vals))

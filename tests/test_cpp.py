@@ -392,3 +392,24 @@ def test_gol_native_resume_errors(tmp_path):
     # grid-shape mismatch
     r = _run_native(tmp_path, "64", "64", "8", "4", "--resume", "m@4")
     assert r.returncode == 2 and "asks for" in r.stderr
+
+
+def test_gol_native_resume_prunes_stale_wider_run_tiles(tmp_path):
+    # a rewrite with fewer workers must not leave the wider run's
+    # higher-pid tiles behind for resume/assemble to silently mix in
+    # (code-review r3 finding; mirrors golio.remove_stale_tiles)
+    from mpi_tpu import golio
+
+    r = _run_native(tmp_path, "24", "24", "8", "16", "--save", "--seed", "3",
+                    "--name", "w", "--workers", "9")  # 3x3 mesh, pids 0-8
+    assert r.returncode == 0, r.stderr
+    r = _run_native(tmp_path, "24", "24", "8", "8", "--save",
+                    "--resume", "w@8", "--workers", "4")  # rewrites 16 as 2x2
+    assert r.returncode == 0, r.stderr
+    assert golio.iteration_tile_pids(str(tmp_path), "w", 16) == [0, 1, 2, 3]
+    r = _run_native(tmp_path, "24", "24", "8", "16", "--save", "--seed", "3",
+                    "--name", "ref", "--workers", "1")
+    assert r.returncode == 0, r.stderr
+    np.testing.assert_array_equal(
+        golio.assemble(str(tmp_path), "w", 16),
+        golio.assemble(str(tmp_path), "ref", 16))

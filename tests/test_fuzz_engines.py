@@ -112,3 +112,69 @@ def test_fuzz_bitltl_padded_widths(case):
         p = ltl_step(p, rule, boundary)
     np.testing.assert_array_equal(
         unpack_np(np.asarray(p)), evolve_np(g, steps, rule, boundary))
+
+
+RNG_R3 = np.random.default_rng(0xB0_5C0)  # own stream: stable under -k
+
+
+def _no_b0(rule):
+    return (Rule(rule.name, rule.birth - {0}, rule.survive, rule.radius)
+            if 0 in rule.birth else rule)
+
+
+@pytest.mark.parametrize("case", CASES[:6])
+def test_fuzz_sharded_ltl_overlap(case):
+    # random rules/shapes through the round-3 stitched-band LtL overlap
+    # stepper on a (2,2) mesh (tiles sized so the overlap body engages)
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_tpu.ops.bitlife import pack_np, unpack_np
+    from mpi_tpu.parallel.mesh import make_mesh
+    from mpi_tpu.parallel.step import make_sharded_ltl_stepper, grid_sharding
+
+    rule, rows, cols, seed, steps, boundary = case
+    rule = _no_b0(rule)
+    r = rule.radius
+    K = 2 if 2 * r <= 31 else 1
+    rows = 2 * max(rows, 2 * K * r)       # mesh_i = 2 divides, bands fit
+    cols = 2 * 32 * (cols // 32 + 2)      # mesh_j = 2, word-aligned shards
+    mesh = make_mesh((2, 2))
+    g = init_tile_np(rows, cols, seed=seed)
+    ev = make_sharded_ltl_stepper(mesh, rule, boundary,
+                                  gens_per_exchange=K, overlap=True)
+    p = jax.device_put(jnp.asarray(pack_np(g)), grid_sharding(mesh))
+    out = unpack_np(np.asarray(ev(p, steps)))
+    np.testing.assert_array_equal(out, evolve_np(g, steps, rule, boundary))
+
+
+def test_fuzz_pallas_ltl_gens():
+    # random r in 2..4 rules through the temporally-blocked LtL kernel
+    # (interpret mode, forced small blocks) at its max gens depth
+    import jax.numpy as jnp
+
+    from mpi_tpu.ops.bitlife import pack_np, unpack_np
+    from mpi_tpu.ops.pallas_bitltl import max_gens, pallas_ltl_step
+
+    for i in range(2):
+        r = int(RNG_R3.integers(2, 5))
+        nmax = (2 * r + 1) ** 2 - 1
+        birth = frozenset(
+            int(x) for x in
+            RNG_R3.choice(nmax, size=int(RNG_R3.integers(1, 5)),
+                          replace=False) + 1)
+        survive = frozenset(
+            int(x) for x in
+            RNG_R3.choice(nmax + 1, size=int(RNG_R3.integers(0, 6)),
+                          replace=False))
+        rule = Rule(f"fuzz3-r{r}", birth, survive, radius=r)
+        gens = max_gens(r)
+        boundary = ["periodic", "dead"][i % 2]
+        g = init_tile_np(32, 4096, seed=3000 + i)
+        p = jnp.asarray(pack_np(g))
+        for _ in range(2):
+            p = pallas_ltl_step(p, rule, boundary, interpret=True,
+                                blocks=(16, 8), gens=gens)
+        np.testing.assert_array_equal(
+            unpack_np(np.asarray(p)),
+            evolve_np(g, 2 * gens, rule, boundary))

@@ -161,3 +161,35 @@ def test_remove_stale_tiles_covers_packed(tmp_path):
     golio.write_snapshot_tiles(d, "run", 0, [(t, 0, 0)])
     assert not os.path.exists(golio.tile_path_packed(d, "run", 0, 7))
     assert golio.iteration_tile_pids(d, "run", 0) == [0]
+
+
+def test_fuzz_assemble_random_tilings_mixed_formats(tmp_path):
+    # random tile splits, random per-tile format: assemble must rebuild
+    # the exact grid (the cross-decomposition resume path depends on it)
+    rng = np.random.default_rng(0xA55E)
+    for case in range(5):
+        d = str(tmp_path / f"c{case}")
+        import os
+
+        os.makedirs(d)
+        rows = int(rng.integers(8, 60))
+        cols = int(rng.integers(8, 60))
+        full = init_tile_np(rows, cols, seed=case)
+        golio.write_master(d, "fz", rows, cols, 1, 1, 1)
+        # random row/col cut points -> irregular but covering tiling
+        rcuts = sorted({0, rows, *map(int, rng.integers(1, rows, size=2))})
+        ccuts = sorted({0, cols, *map(int, rng.integers(1, cols, size=2))})
+        pid = 0
+        for r0, r1 in zip(rcuts, rcuts[1:]):
+            for c0, c1 in zip(ccuts, ccuts[1:]):
+                tile = full[r0:r1, c0:c1]
+                fmt = ["gol", "golp"][int(rng.integers(0, 2))]
+                golio.write_tile_fmt(d, "fz", 0, pid, tile, r0, c0, fmt=fmt)
+                pid += 1
+        np.testing.assert_array_equal(golio.assemble(d, "fz", 0), full)
+        # a random sub-rectangle too (the multihost per-host load path)
+        rr0 = int(rng.integers(0, rows)); rr1 = int(rng.integers(rr0 + 1, rows + 1))
+        cc0 = int(rng.integers(0, cols)); cc1 = int(rng.integers(cc0 + 1, cols + 1))
+        np.testing.assert_array_equal(
+            golio.assemble_region(d, "fz", 0, rr0, rr1, cc0, cc1),
+            full[rr0:rr1, cc0:cc1])

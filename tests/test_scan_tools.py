@@ -67,3 +67,29 @@ def test_steps_for_budget_invariants():
                                 (2e12, 4096 * 4096, 1)):
         steps = scan_common.steps_for_budget(budget, cells, gens)
         assert steps >= gens and steps % gens == 0
+
+
+def test_ltl_gens_ladder_points_supported():
+    # every (radius, gens) point the hardware ladder will run must pass
+    # the kernel's capability check and use a rule of the right radius —
+    # catch drift here, not as a mid-ladder child crash on the real chip
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ltl_gens_ladder",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "ltl_gens_ladder.py"))
+    lad = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lad)
+
+    from mpi_tpu.models.rules import rule_from_name
+    from mpi_tpu.ops.pallas_bitltl import max_gens, supports
+
+    for radius, gens, budget in lad.POINTS:
+        rule = rule_from_name(lad.RULES[radius])
+        assert rule.radius == radius
+        assert 0 not in rule.birth
+        assert gens <= max_gens(radius)
+        assert supports((lad.SIDE, lad.SIDE), rule, gens=gens), (radius, gens)
+        assert budget > 0

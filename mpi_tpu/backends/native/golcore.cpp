@@ -290,10 +290,20 @@ static inline void add_planes(uint64_t* a, int na, const uint64_t* b, int nb) {
     uint64_t carry = 0;
     for (int p = 0; p < na; ++p) {
         const uint64_t x = a[p], y = p < nb ? b[p] : 0;
-        a[p] = x ^ y ^ carry;
-        carry = (x & y) | (carry & (x ^ y));
+        const uint64_t t = x ^ y;
+        a[p] = t ^ carry;
+        carry = (x & y) | (carry & t);
     }
 }
+
+// (A carry-save 3:2-compressor accumulator — the Wallace-tree shape the
+// Python engine's bs_sum uses, ops/bitltl.py — was tried here and
+// MEASURED SLOWER on CPU: 0.35 vs 0.42 Gcell/s for Bosco at 2048², one
+// core.  The per-weight bucket arrays force stack traffic and dynamic
+// indexing where the ripple chains keep t[]/addL/addR in registers with
+// plenty of scalar ILP; the op-count saving only pays on wide-vector
+// machines, which is why the TPU engines use bs_sum and this one keeps
+// sequential add_planes.)
 
 // one generation of rows [lo_row, hi_row) on an r-ghost-row padded packed
 // buffer; vplanes is nv*nw scratch for the per-row vertical sums

@@ -16,7 +16,14 @@ import os
 
 
 def apply_platform_override() -> None:
-    plat = os.environ.get("MPI_TPU_PLATFORM")
+    """Honor an explicit platform request from the environment.
+
+    ``MPI_TPU_PLATFORM`` wins; a bare ``JAX_PLATFORMS`` is honored too —
+    users reasonably expect JAX's own env var to work, and without the
+    re-pin the ambient sitecustomize silently overrides it (on a dead
+    TPU tunnel that turns a requested-CPU run into an indefinite
+    backend-init hang)."""
+    plat = os.environ.get("MPI_TPU_PLATFORM") or os.environ.get("JAX_PLATFORMS")
     if plat:
         import jax
 

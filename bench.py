@@ -110,11 +110,15 @@ def child(size: int, steps: int, gens: int) -> None:
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, supports
 
     platform = jax.devices()[0].platform
-    if platform != "tpu" and not os.environ.get("MPI_TPU_PLATFORM"):
+    if platform != "tpu" and not (
+        os.environ.get("MPI_TPU_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    ):
         # a transient TPU plugin-init failure makes JAX fall back to CPU
         # silently; a CPU number must never masquerade as the TPU metric —
         # fail so the parent's retry/backoff (or its explicit degraded CPU
-        # fallback, which sets MPI_TPU_PLATFORM) takes over
+        # fallback, which sets MPI_TPU_PLATFORM) takes over.  An EXPLICIT
+        # env request for another platform (either variable — both are
+        # honored by apply_platform_override) is not a masquerade.
         raise RuntimeError(f"expected tpu platform, got {platform!r}")
     if platform == "tpu":
         assert supports((size, size), LIFE, gens=gens)

@@ -15,8 +15,9 @@ from __future__ import annotations
 import os
 
 
-def apply_platform_override() -> None:
-    """Honor an explicit platform request from the environment.
+def apply_platform_override():
+    """Honor an explicit platform request from the environment; returns
+    the platform string that was applied (None if no request).
 
     ``MPI_TPU_PLATFORM`` wins; a bare ``JAX_PLATFORMS`` is honored too —
     users reasonably expect JAX's own env var to work, and without the
@@ -28,6 +29,7 @@ def apply_platform_override() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    return plat or None
 
 
 def force_fetch(g) -> None:

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Round-3 hardware program (ROUND3.md "Queued for the next healthy
+# tunnel window"), one command so a short window is not wasted on
+# orchestration.  Each step is independently resumable; artifacts land
+# under perf/ and logs under perf/hw_session_logs/.
+#
+#   bash tools/hw_session.sh            # run the full queue
+#   bash tools/hw_session.sh bench      # just one step
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+LOGS=perf/hw_session_logs
+mkdir -p "$LOGS"
+
+probe() {
+  python -c "from mpi_tpu.utils.platform import probe_platform; import sys; sys.exit(0 if probe_platform() == 'tpu' else 1)"
+}
+
+FAILED=()
+
+step() {  # step <name> <cmd...>
+  local name=$1; shift
+  echo "=== hw_session: $name ==="
+  if ! probe; then
+    echo "hw_session: tunnel not answering before '$name' — stopping" >&2
+    exit 1
+  fi
+  ( "$@" ) 2>&1 | tee "$LOGS/$name.log"
+  local rc=${PIPESTATUS[0]}
+  echo "=== $name done (rc=$rc) ==="
+  # later steps still run (bench failing must not block the ladders),
+  # but a failed step must not vanish into an exit-0 "queue complete"
+  if [ "$rc" -ne 0 ]; then FAILED+=("$name"); fi
+  return 0
+}
+
+want=${1:-all}
+
+# 1. Bench first: banks the 8192^2 rung within ~2 minutes of a healthy
+#    probe, so the round holds a fresh hardware number whatever happens
+#    to the rest of the queue.
+[ "$want" = all ] || [ "$want" = bench ] && \
+  step bench python bench.py
+
+# 2. Throughput roof (16-way parallel chains) + regenerated %roof table.
+[ "$want" = all ] || [ "$want" = roof ] && \
+  step roof python tools/roofline.py --measure-roof
+
+# 3. Engine ladder refresh — the Wallace-tree LtL rewrite moved the
+#    bit-sliced compute bound ~3.5x; expect bosco rows well above the
+#    old 106 Gcell/s.
+[ "$want" = all ] || [ "$want" = ladder ] && \
+  step ladder python tools/engine_ladder.py
+
+# 4. LtL temporal-blocking ladder: keep gens>1 in the dispatch only
+#    where a row wins.
+[ "$want" = all ] || [ "$want" = gens ] && \
+  step gens python tools/ltl_gens_ladder.py
+
+# 5. Hardware spot-check of the new Mosaic-compiled paths (overlap +
+#    gens) at product scale via the CLI: radius-2 gens dispatch and a
+#    bosco (r=5, bs_sum kernel) run, timed reports written to perf/.
+if [ "$want" = all ] || [ "$want" = spot ]; then
+  step spot-r2g4 python -m mpi_tpu.cli 16384 16384 0 64 hw_spot 1 \
+    --backend tpu --rule "R2,B10-13,S8-12" --comm-every 4 \
+    --out-dir perf --name hw-spot-r2g4
+  step spot-bosco python -m mpi_tpu.cli 16384 16384 0 32 hw_spot 0 \
+    --backend tpu --rule bosco \
+    --out-dir perf --name hw-spot-bosco
+fi
+
+if [ ${#FAILED[@]} -gt 0 ]; then
+  echo "hw_session: FAILED steps: ${FAILED[*]} (see $LOGS/)" >&2
+  exit 1
+fi
+echo "hw_session: queue complete; review perf/ artifacts and PERF.md"

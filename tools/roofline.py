@@ -38,6 +38,9 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+# remember whether the USER set JAX_PLATFORMS before this module's own
+# tracing-only CPU pin — measure_roof must undo the pin, not honor it
+_EXTERNAL_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
@@ -130,7 +133,14 @@ def measure_roof(parallel: int = 16, depth: int = 512,
     from mpi_tpu.utils.platform import apply_platform_override, force_fetch
 
     # undo this module's import-time CPU pin (tracing-only safety): the
-    # roof must come from the real device; MPI_TPU_PLATFORM still wins
+    # roof must come from the real device.  apply_platform_override now
+    # honors JAX_PLATFORMS too, so restore the env to what the USER set
+    # (if anything) before calling it — otherwise the module's own pin
+    # would silently make --measure-roof measure the CPU "roof".
+    if _EXTERNAL_JAX_PLATFORMS is None:
+        os.environ.pop("JAX_PLATFORMS", None)
+    else:
+        os.environ["JAX_PLATFORMS"] = _EXTERNAL_JAX_PLATFORMS
     jax.config.update("jax_platforms", None)
     apply_platform_override()
 

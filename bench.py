@@ -110,15 +110,18 @@ def child(size: int, steps: int, gens: int) -> None:
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step, supports
 
     platform = jax.devices()[0].platform
-    if platform != "tpu" and not (
-        os.environ.get("MPI_TPU_PLATFORM") or os.environ.get("JAX_PLATFORMS")
-    ):
+    requested = (
+        os.environ.get("MPI_TPU_PLATFORM")
+        or os.environ.get("JAX_PLATFORMS") or ""
+    ).split(",")[0].strip().lower()
+    if platform != "tpu" and platform != requested:
         # a transient TPU plugin-init failure makes JAX fall back to CPU
         # silently; a CPU number must never masquerade as the TPU metric —
         # fail so the parent's retry/backoff (or its explicit degraded CPU
-        # fallback, which sets MPI_TPU_PLATFORM) takes over.  An EXPLICIT
-        # env request for another platform (either variable — both are
-        # honored by apply_platform_override) is not a masquerade.
+        # fallback, which sets MPI_TPU_PLATFORM) takes over.  Only an
+        # EXPLICIT first-choice env request for this exact platform is
+        # not a masquerade — a fallback list like JAX_PLATFORMS=tpu,cpu
+        # landing on cpu still is.
         raise RuntimeError(f"expected tpu platform, got {platform!r}")
     if platform == "tpu":
         assert supports((size, size), LIFE, gens=gens)

@@ -65,13 +65,14 @@ def _pallas_single_device_mode():
 
 
 def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
-    """Packed-engine stepper: on a single device the fused Pallas SWAR
-    kernel (ops/pallas_bitlife.py) replaces the shard_map/XLA path — no
-    halo exchange exists, ``comm_every`` becomes the kernel's
-    temporal-blocking depth (generations per HBM round-trip), and a
-    requested ``overlap`` is vacuous (no collective to overlap with), so
-    the fused kernel is taken regardless of the flag.  Multi-device
-    meshes (and off-TPU production runs) use the ppermute stepper."""
+    """(stepper, used_pallas) for the packed engine: on a single device
+    the fused Pallas SWAR kernel (ops/pallas_bitlife.py) replaces the
+    shard_map/XLA path — no halo exchange exists, ``comm_every`` becomes
+    the kernel's temporal-blocking depth (generations per HBM
+    round-trip), and a requested ``overlap`` is vacuous (no collective
+    to overlap with), so the fused kernel is taken regardless of the
+    flag.  Multi-device meshes (and off-TPU production runs) use the
+    ppermute stepper."""
     from mpi_tpu.parallel.step import make_sharded_bit_stepper
 
     if n_devices == 1:
@@ -84,11 +85,11 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int):
         if use and supports(shape, config.rule, gens=gens):
             return make_pallas_bit_stepper(
                 config.rule, config.boundary, interpret=interpret, gens=gens
-            )
+            ), True
     return make_sharded_bit_stepper(
         mesh, config.rule, config.boundary,
         gens_per_exchange=config.comm_every, overlap=config.overlap,
-    )
+    ), False
 
 
 def select_ltl_mode(config: GolConfig, mi: int, mj: int):
@@ -157,16 +158,17 @@ def _ltl_single_device(config: GolConfig) -> bool:
 
 
 def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int):
-    """Dense-engine stepper: on a single device the fused dense Pallas
-    kernel (ops/pallas_stencil.py, one HBM read + one write per cell per
-    step) replaces the shard_map/XLA path, which would otherwise serve a
-    higher-radius single-chip run with the slowest engine.  The kernel has
-    no temporal blocking, so an explicit --comm-every > 1 keeps the
-    sharded stepper (whose K-deep self-exchange honors it) instead of
-    being silently dropped; ``overlap`` is vacuous on one device (no
-    collective to overlap with — same contract as the packed engine) and
-    does not affect the dispatch.  Multi-device meshes (and off-TPU
-    production runs) use the ppermute stepper."""
+    """(stepper, used_pallas) for the dense engine: on a single device
+    the fused dense Pallas kernel (ops/pallas_stencil.py, one HBM read +
+    one write per cell per step) replaces the shard_map/XLA path, which
+    would otherwise serve a higher-radius single-chip run with the
+    slowest engine.  The kernel has no temporal blocking, so an explicit
+    --comm-every > 1 keeps the sharded stepper (whose K-deep
+    self-exchange honors it) instead of being silently dropped;
+    ``overlap`` is vacuous on one device (no collective to overlap with
+    — same contract as the packed engine) and does not affect the
+    dispatch.  Multi-device meshes (and off-TPU production runs) use the
+    ppermute stepper."""
     if n_devices == 1 and config.comm_every == 1:
         from mpi_tpu.ops.pallas_stencil import make_pallas_stepper, supports
 
@@ -174,11 +176,11 @@ def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int):
         if use and supports((config.rows, config.cols), config.rule):
             return make_pallas_stepper(
                 config.rule, config.boundary, interpret=interpret
-            )
+            ), True
     return make_sharded_stepper(
         mesh, config.rule, config.boundary,
         gens_per_exchange=config.comm_every, overlap=config.overlap,
-    )
+    ), False
 
 
 def _put_initial(mesh, initial, rows: int, cols: int, packed: bool):
@@ -299,6 +301,7 @@ def run_tpu(
                 config.rule, config.boundary, interpret=interpret,
                 gens=config.comm_every,
             )
+            used_pallas = True
         elif ltl_mode == "sharded":
             from mpi_tpu.parallel.step import make_sharded_ltl_stepper
 
@@ -306,14 +309,15 @@ def run_tpu(
                 mesh, config.rule, config.boundary,
                 gens_per_exchange=config.comm_every, overlap=config.overlap,
             )
+            used_pallas = False
         else:
-            evolve = _pick_packed_evolve(config, mesh, mi * mj)
+            evolve, used_pallas = _pick_packed_evolve(config, mesh, mi * mj)
         if initial is not None:
             grid = _put_initial(mesh, initial, config.rows, config.cols, True)
         else:
             grid = sharded_bit_init(mesh, config.rows, config.cols, config.seed)
     else:
-        evolve = _pick_dense_evolve(config, mesh, mi * mj)
+        evolve, used_pallas = _pick_dense_evolve(config, mesh, mi * mj)
         if initial is not None:
             grid = _put_initial(mesh, initial, config.rows, config.cols, False)
         else:
@@ -324,9 +328,50 @@ def run_tpu(
 
     # Compile every distinct segment length ahead of time: compilation is
     # "setup", steady-state stepping is what throughput is measured on.
-    compiled = {}
-    for n in sorted(set(segments)):
-        compiled[n] = evolve.lower(grid, n).compile()
+    def compile_segments(ev):
+        return {n: ev.lower(grid, n).compile() for n in sorted(set(segments))}
+
+    try:
+        compiled = compile_segments(evolve)
+    except Exception as e:  # noqa: BLE001 — Mosaic/VMEM errors vary by version
+        # A fused Pallas kernel that fails to COMPILE (Mosaic register
+        # allocation, a VMEM shape outside the calibrated map) must
+        # degrade to the always-available shard_map/XLA stepper instead
+        # of killing a production run.  If the dispatch never chose a
+        # Pallas kernel, the error is real — re-raise rather than pay a
+        # second identical compile under a misleading note.
+        if not used_pallas:
+            raise
+        import sys
+
+        print(
+            f"note: fused kernel failed to compile "
+            f"({type(e).__name__}: {str(e)[:200]}); falling back to the "
+            f"XLA stepper",
+            file=sys.stderr,
+        )
+        from mpi_tpu.parallel.step import (
+            make_sharded_bit_stepper, make_sharded_ltl_stepper,
+        )
+
+        if packed_mode:
+            evolve = make_sharded_bit_stepper(
+                mesh, config.rule, config.boundary,
+                gens_per_exchange=config.comm_every, overlap=config.overlap,
+            )
+        elif ltl_mode:
+            # comm_every·r ≤ max_gens(r)·r ≤ 8·1 | 4·2 | 2·4 ≤ 8 word
+            # halo bits — always within the sharded stepper's 31-bit bound
+            evolve = make_sharded_ltl_stepper(
+                mesh, config.rule, config.boundary,
+                gens_per_exchange=config.comm_every, overlap=config.overlap,
+            )
+        else:
+            evolve = make_sharded_stepper(
+                mesh, config.rule, config.boundary,
+                gens_per_exchange=config.comm_every, overlap=config.overlap,
+            )
+        compiled = compile_segments(evolve)
 
     from mpi_tpu.utils.platform import force_fetch
 

@@ -483,3 +483,32 @@ def test_bs_sum_matches_integer_sums():
     )
     np.testing.assert_array_equal(
         got.ravel(), sum(v.astype(np.uint64) for v in vals))
+
+
+def test_run_tpu_pallas_compile_failure_falls_back(monkeypatch, capsys):
+    # a fused kernel that fails to compile (Mosaic/VMEM on an unmapped
+    # shape) must degrade to the XLA stepper with a note, not kill the
+    # run — for both the LtL and SWAR single-device dispatches
+    import mpi_tpu.ops.pallas_bitlife as pb
+    import mpi_tpu.ops.pallas_bitltl as pbl
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic: simulated VMEM OOM")
+
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(pbl, "pallas_ltl_step", boom)
+    cfg = GolConfig(rows=32, cols=4096, steps=3, seed=5, rule=R2,
+                    mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    assert "falling back to the XLA stepper" in capsys.readouterr().err
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 4096, seed=5), 3, R2, "periodic"))
+
+    monkeypatch.setattr(pb, "pallas_bit_step", boom)
+    cfg = GolConfig(rows=16, cols=4096, steps=3, seed=7, mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    assert "falling back to the XLA stepper" in capsys.readouterr().err
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(16, 4096, seed=7), 3, LIFE, "periodic"))

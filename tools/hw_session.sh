@@ -23,6 +23,9 @@ step() {  # step <name> <cmd...>
   echo "=== hw_session: $name ==="
   if ! probe; then
     echo "hw_session: tunnel not answering before '$name' — stopping" >&2
+    if [ ${#FAILED[@]} -gt 0 ]; then
+      echo "hw_session: FAILED steps so far: ${FAILED[*]} (see $LOGS/)" >&2
+    fi
     exit 1
   fi
   ( "$@" ) 2>&1 | tee "$LOGS/$name.log"

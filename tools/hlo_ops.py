@@ -1,0 +1,99 @@
+"""Count u32 logic instructions in the OPTIMIZED HLO of each engine's
+step — the post-XLA-optimizer companion to tools/roofline.py's pre-CSE
+jaxpr counts.
+
+The jaxpr count is an upper bound (XLA may CSE/fuse); this counts what
+the compiler actually schedules, so claims like "the Wallace-tree
+rewrite survives XLA's optimizer" (PERF.md: 2887 → 602 instructions for
+one Bosco step) are reproducible:
+
+    python tools/hlo_ops.py
+    python tools/hlo_ops.py --against <git-rev>   # compare ops/bitltl.py
+
+Instruction counts are per fused array op on a (256, 8)-word grid; the
+ratio between two versions is the meaningful number (absolute counts
+mix in boundary masking and layout ops).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # beats the ambient sitecustomize
+
+import jax.numpy as jnp
+
+LOGIC = r"(and|or|xor|add|subtract|shift-left|shift-right-logical|not)"
+_RE = re.compile(r"= u32\[[\d,]*\]\{?[\d,]*\}? " + LOGIC + r"\(")
+
+
+def hlo_logic_instrs(step_fn, packed) -> int:
+    txt = jax.jit(step_fn).lower(packed).compile().as_text()
+    return len(_RE.findall(txt))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--against", default=None, metavar="REV",
+                    help="also count REV's mpi_tpu/ops/bitltl.py for the ratio")
+    args = ap.parse_args()
+
+    from mpi_tpu.models.rules import BOSCO, LIFE, rule_from_name
+    from mpi_tpu.ops import bitlife, bitltl
+
+    side = 256
+    packed = jnp.zeros((side, side // 32), dtype=jnp.uint32)
+
+    rows = [
+        ("swar-xla life", lambda p: bitlife.bit_step(p, LIFE, "periodic")),
+        ("bitltl r2", lambda p: bitltl.ltl_step(
+            p, rule_from_name("R2,B10-13,S8-12"), "periodic")),
+        ("bitltl bosco", lambda p: bitltl.ltl_step(p, BOSCO, "periodic")),
+    ]
+    for name, fn in rows:
+        print(f"{name}: {hlo_logic_instrs(fn, packed)} optimized-HLO "
+              f"u32 logic instructions")
+
+    if args.against:
+        proc = subprocess.run(
+            ["git", "show", f"{args.against}:mpi_tpu/ops/bitltl.py"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        if proc.returncode != 0:
+            err = proc.stderr.strip().splitlines()
+            detail = err[-1][:200] if err else f"rc={proc.returncode}"
+            print(f"error: cannot read ops/bitltl.py at {args.against!r}: "
+                  f"{detail}", file=sys.stderr)
+            return 2
+        src = proc.stdout
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False
+        ) as f:
+            f.write(src)
+            path = f.name
+        try:
+            spec = importlib.util.spec_from_file_location("bitltl_old", path)
+            old = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(old)
+            n = hlo_logic_instrs(
+                lambda p: old.ltl_step(p, BOSCO, "periodic"), packed)
+            print(f"bitltl bosco @{args.against}: {n}")
+        finally:
+            os.unlink(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

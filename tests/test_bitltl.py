@@ -512,3 +512,22 @@ def test_run_tpu_pallas_compile_failure_falls_back(monkeypatch, capsys):
     assert "falling back to the XLA stepper" in capsys.readouterr().err
     np.testing.assert_array_equal(
         out, evolve_np(init_tile_np(16, 4096, seed=7), 3, LIFE, "periodic"))
+
+
+def test_run_tpu_dense_pallas_compile_failure_falls_back(monkeypatch, capsys):
+    # the dense fused kernel path degrades the same way
+    import mpi_tpu.ops.pallas_stencil as ps
+    from mpi_tpu.backends.tpu import run_tpu
+    from mpi_tpu.config import GolConfig
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic: simulated register spill")
+
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(ps, "pallas_step", boom)
+    cfg = GolConfig(rows=32, cols=128, steps=2, seed=5, rule=R2,
+                    mesh_shape=(1, 1))
+    out = run_tpu(cfg)
+    assert "falling back to the XLA stepper" in capsys.readouterr().err
+    np.testing.assert_array_equal(
+        out, evolve_np(init_tile_np(32, 128, seed=5), 2, R2, "periodic"))

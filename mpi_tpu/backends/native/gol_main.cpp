@@ -549,12 +549,25 @@ int main(int argc, char** argv) {
     // their full time is setup (shared, program-wide) + measured nosetup.
     long nos_avg = nosetup, nos_sum = nosetup * p;
     {
+        // avg over the slots that actually accumulated time: the engine
+        // may run fewer threads than p (w is capped at the row count and
+        // the blocked engine credits only w slots), and averaging over
+        // idle slots would under-report per-worker time relative to the
+        // reference's per-rank MPI_Reduce semantics (main.cpp:319-324)
         int64_t sum = 0;
-        for (int64_t v : worker_us) sum += v;
-        if (sum > 0) {
-            nos_avg = (long)(sum / p);
+        int active = 0;
+        for (int64_t v : worker_us) {
+            sum += v;
+            if (v > 0) ++active;
+        }
+        if (sum > 0 && active > 0) {
+            nos_avg = (long)(sum / active);
             nos_sum = (long)sum;
         }
+        // NB: when active < p the avg and sum columns describe the active
+        // workers while #P stays the decomposition (tile-writer count), so
+        // avg * #P deliberately over-reconstructs sum — #P is the wire
+        // contract (reference CSV schema), not the thread count.
     }
     long full_avg = setup + nos_avg, full_sum = (long)setup * p + nos_sum;
 

@@ -770,10 +770,14 @@ int gol_evolve_par_t(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                                  steps, periodic, w)) {
                 if (worker_us) {
                     // the blocked engine forks/joins its workers every block
-                    // row, so each worker's measured span is the whole call
+                    // row, so each worker's measured span is the whole call.
+                    // Credit >= 1us so a nonzero slot reliably means "this
+                    // worker ran" (gol_main derives the active-worker count
+                    // from nonzero slots) even when the span truncates to 0.
                     int64_t us = std::chrono::duration_cast<
                         std::chrono::microseconds>(
                         std::chrono::steady_clock::now() - b0).count();
+                    if (us < 1) us = 1;
                     for (int t = 0; t < w; ++t) worker_us[t] += us;
                 }
                 return 0;
@@ -805,10 +809,12 @@ int gol_evolve_par_t(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                         cur = 1 - cur;
                         barrier.arrive_and_wait();  // all bands written
                     }
-                    if (worker_us)
-                        worker_us[t] += std::chrono::duration_cast<
+                    if (worker_us) {
+                        int64_t us = std::chrono::duration_cast<
                             std::chrono::microseconds>(
                             std::chrono::steady_clock::now() - w0).count();
+                        worker_us[t] += us < 1 ? 1 : us;  // nonzero == ran
+                    }
                 });
             }
             for (auto& th : threads) th.join();
@@ -857,10 +863,12 @@ int gol_evolve_par_t(uint8_t* grid, int64_t rows, int64_t cols, int64_t steps,
                     cur_is_a = !cur_is_a;
                     barrier.arrive_and_wait();  // all interiors written
                 }
-                if (worker_us)
-                    worker_us[(size_t)i * e.tj + j] += std::chrono::duration_cast<
+                if (worker_us) {
+                    int64_t us = std::chrono::duration_cast<
                         std::chrono::microseconds>(
                         std::chrono::steady_clock::now() - w0).count();
+                    worker_us[(size_t)i * e.tj + j] += us < 1 ? 1 : us;
+                }
             });
         }
     }

@@ -57,12 +57,24 @@ def tile_path_packed(out_dir: str, name: str, iteration: int, pid: int) -> str:
 def find_tile_path(out_dir: str, name: str, iteration: int, pid: int) -> str:
     """The on-disk tile file for (iteration, pid), whichever format it was
     written in.  Writers keep one canonical file per pid (rewrites remove
-    the other format), so at most one should exist; if both somehow do,
-    the packed one wins (it is what a production-scale rewrite leaves)."""
+    the other format), so at most one should exist; if both somehow do —
+    a writer crashed between its atomic write and removing the other
+    format — the *newer* one wins: tiles are written complete (temp +
+    ``os.replace``), so mtime order is write order and a stale format
+    cannot shadow a fresh rewrite.  (Equal timestamps — possible only
+    through timestamp-preserving restores or a coarse-mtime filesystem —
+    resolve to the text side, an arbitrary but deterministic choice.)"""
     packed = tile_path_packed(out_dir, name, iteration, pid)
-    if os.path.exists(packed):
+    text = tile_path(out_dir, name, iteration, pid)
+    try:
+        pt = os.stat(packed).st_mtime_ns
+    except FileNotFoundError:
+        return text
+    try:
+        tt = os.stat(text).st_mtime_ns
+    except FileNotFoundError:
         return packed
-    return tile_path(out_dir, name, iteration, pid)
+    return text if tt >= pt else packed
 
 
 def write_master(
@@ -98,12 +110,16 @@ def write_tile(
 ) -> str:
     rows, cols = tile.shape
     path = tile_path(out_dir, name, iteration, pid)
-    with open(path, "w") as f:
+    # temp + atomic replace: a reader (or a crash) can never observe a
+    # truncated tile at the canonical path
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         f.write(f"{first_row} {first_row + rows - 1}\n")
         f.write(f"{first_col} {first_col + cols - 1}\n")
         for r in tile:
             # trailing tab matches the reference's ostream_iterator output
             f.write("\t".join("1" if v else "0" for v in r) + "\t\n")
+    os.replace(tmp, path)
     return path
 
 
@@ -117,11 +133,13 @@ def write_tile_packed(
     rows, cols = tile.shape
     path = tile_path_packed(out_dir, name, iteration, pid)
     body = np.packbits(np.asarray(tile, dtype=np.uint8), axis=1)
-    with open(path, "wb") as f:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
         f.write(GOLP_MAGIC)
         f.write(f"{first_row} {first_row + rows - 1}\n".encode())
         f.write(f"{first_col} {first_col + cols - 1}\n".encode())
         f.write(body.tobytes())
+    os.replace(tmp, path)
     return path
 
 
@@ -277,7 +295,11 @@ def write_tile_fmt(
 ) -> str:
     """One tile in the selected format ("gol", "golp", or "auto" = packed
     above GOLP_THRESHOLD cells), removing the other format's file for the
-    same pid so rewrites leave exactly one canonical tile."""
+    same pid so rewrites leave exactly one canonical tile.  The new tile
+    lands atomically (temp + ``os.replace``) *before* the stale format is
+    removed, so a complete tile exists on disk at every instant; if a
+    crash between the two leaves both formats, ``find_tile_path``'s
+    mtime tiebreak still resolves to the fresh one."""
     if fmt not in ("auto", "gol", "golp"):
         raise ValueError(f"unknown snapshot format {fmt!r}")
     packed = fmt == "golp" or (fmt == "auto" and tile.size > GOLP_THRESHOLD)

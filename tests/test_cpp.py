@@ -315,6 +315,26 @@ def test_gol_native_detailed_report_layout(tmp_path):
     assert nos_avg > 0
 
 
+def test_gol_native_avg_over_active_workers(tmp_path):
+    # ADVICE r3: the SWAR engine caps threads at the row count (8 rows,
+    # 16 requested workers -> 8 active slots); the avg column must divide
+    # by the slots that accumulated time, not the decomposition size p,
+    # so sum ~= avg * active (within integer truncation), NOT avg * p.
+    # The workload is sized so per-worker time is far above the active
+    # count (hundreds of us), keeping the active reconstruction below
+    # exact even under integer truncation of avg.
+    r = _run_native(tmp_path, "8", "2048", "200", "400", "cap", "1",
+                    "--workers", "16", "--seed", "3", "--name", "c")
+    assert r.returncode == 0, r.stderr
+    row = (tmp_path / "cap_compact.csv").read_text().splitlines()[-1].split(",")
+    p, nos_avg, nos_sum = int(row[2]), int(row[7]), int(row[8])
+    assert p == 16  # #P stays the decomposition / tile-writer count
+    assert nos_avg > 8  # workload sized to dominate truncation error
+    active = round(nos_sum / nos_avg)
+    assert active <= 8, (nos_sum, nos_avg)  # capped at the row count
+    assert abs(nos_sum - nos_avg * active) <= active  # consistent pair
+
+
 def test_gol_native_resume_roundtrip(tmp_path):
     # run to 16 == run to 8 then --resume half@8, in both tile formats
     for fmt in ("gol", "golp"):

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe every INTERVAL seconds, log each probe, and run
+# the full hardware queue (tools/hw_session.sh) automatically at the
+# first healthy window.  Detached use:
+#
+#   nohup setsid bash tools/hw_watch.sh >/dev/null 2>&1 &
+#
+# Probes append to perf/tunnel_probes_r4.log (same evidence trail as
+# rounds 2-3); the session run logs to perf/hw_session_logs/ as usual.
+# A marker file perf/hw_watch.ran stops duplicate sessions if the
+# watcher is restarted after a successful run.
+set -u
+cd "$(dirname "$0")/.."
+
+INTERVAL=${HW_WATCH_INTERVAL:-900}
+LOG=perf/tunnel_probes_r4.log
+MARK=perf/hw_watch.ran
+mkdir -p perf perf/hw_session_logs
+
+while true; do
+  plat=$(timeout 170 python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform())" 2>/dev/null | tail -1)
+  echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe=${plat:-error}" >> "$LOG"
+  if [ "${plat:-}" = "tpu" ] && [ ! -e "$MARK" ]; then
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel healthy — running hw_session" >> "$LOG"
+    start_stamp=$(mktemp)
+    bash tools/hw_session.sh > perf/hw_session_logs/hw_watch_run.log 2>&1
+    rc=$?
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) hw_session exited rc=$rc" >> "$LOG"
+    # only mark done when the queue actually got through the bench step:
+    # bench_last.json ships in the tree, so require it FRESHER than the
+    # session start, not merely present
+    if [ $rc -eq 0 ] && [ perf/bench_last.json -nt "$start_stamp" ]; then
+      touch "$MARK"
+    fi
+    rm -f "$start_stamp"
+  fi
+  sleep "$INTERVAL"
+done

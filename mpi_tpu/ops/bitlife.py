@@ -83,12 +83,18 @@ def init_packed(
     row_offset=0,
     col_offset=0,
     block_rows: int = 1024,
+    col_limit=None,
 ) -> jax.Array:
     """Hash-init a grid tile directly in packed form, streaming over row
     blocks — a 65536² grid (512 MiB packed) initializes without ever
     materializing the 4 GiB unpacked uint8 array or the 16 GiB pack()
     intermediate.  Offsets make it decomposition-invariant like
-    ``init_tile_jnp`` (traceable, usable inside shard_map)."""
+    ``init_tile_jnp`` (traceable, usable inside shard_map).
+
+    ``col_limit``: cells whose GLOBAL column (col_offset + local) is ≥
+    this are initialized dead — the pad region of a pad-to-32 grid; the
+    hash of every real cell is untouched, so padded and exact-width runs
+    agree bit-for-bit on the real columns."""
     if cols % WORD:
         raise ValueError(f"cols {cols} not a multiple of {WORD}")
     from mpi_tpu.utils.hashinit import init_tile_jnp
@@ -98,8 +104,22 @@ def init_packed(
         block_rows //= 2
 
     def one_block(r0):
-        return pack(init_tile_jnp(block_rows, cols, seed, row_offset=r0,
-                                  col_offset=col_offset))
+        p = pack(init_tile_jnp(block_rows, cols, seed, row_offset=r0,
+                               col_offset=col_offset))
+        if col_limit is not None:
+            # valid bits per word: clamp(col_limit - col_offset - 32w, 0, 32)
+            w = jnp.arange(cols // WORD, dtype=jnp.int32)
+            v = jnp.clip(
+                jnp.int32(col_limit)
+                - jnp.asarray(col_offset, jnp.int32)[None]
+                - w * WORD, 0, WORD,
+            )
+            mask = jnp.where(
+                v >= WORD, jnp.uint32(0xFFFFFFFF),
+                (jnp.uint32(1) << v.astype(jnp.uint32)) - jnp.uint32(1),
+            )
+            p = p & mask[None, :]
+        return p
 
     starts = jnp.uint32(row_offset) + jnp.arange(0, rows, block_rows, dtype=jnp.uint32)
     blocks = lax.map(one_block, starts)

@@ -156,6 +156,19 @@ def _halo_rows(gens: int) -> int:
     return 8 if gens <= 8 else 16
 
 
+def _out_struct(packed, H: int, NW: int):
+    """Output aval for the kernel: when tracing inside ``shard_map`` the
+    result varies over the same mesh axes as the input, and shard_map's
+    vma checking requires that to be declared on the out_shape."""
+    try:
+        vma = jax.typeof(packed).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct((H, NW), jnp.uint32, vma=vma)
+    return jax.ShapeDtypeStruct((H, NW), jnp.uint32)
+
+
 def _make_kernel(
     rule: Rule, boundary: str, H: int, NW: int, BM: int, CM: int, gens: int = 1
 ):
@@ -335,7 +348,7 @@ def pallas_bit_step(
     return pl.pallas_call(
         kernel,
         grid=(H // BM,),
-        out_shape=jax.ShapeDtypeStruct((H, NW), jnp.uint32),
+        out_shape=_out_struct(packed, H, NW),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((BM, NW), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[

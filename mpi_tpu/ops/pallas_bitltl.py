@@ -291,10 +291,12 @@ def pallas_ltl_step(
         # dead-boundary halo rows must stay dead across in-VMEM generations
         raise ValueError("gens > 1 requires a rule without birth-on-0")
     kernel = _make_kernel(rule, boundary, H, NW, BM, CM, gens)
+    from mpi_tpu.ops.pallas_bitlife import _out_struct
+
     return pl.pallas_call(
         kernel,
         grid=(H // BM,),
-        out_shape=jax.ShapeDtypeStruct((H, NW), jnp.uint32),
+        out_shape=_out_struct(packed, H, NW),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((BM, NW), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[

@@ -16,8 +16,10 @@ honest-measurement caveat).  The shape of the policy — more latency →
 deeper halos, bounded by engine limits and tile fringe budget — is the
 part under test; the numbers are meant to be recalibrated with
 ``probe_collective_latency_us`` output on ICI/DCN once a slice is
-available.  Single-device runs keep today's behavior (K=1, overlap as
-requested): there is no collective to avoid or hide.
+available.  Single-device runs have no collective to avoid or hide, but
+the fused radius-1 kernel reinterprets K as its temporal-blocking depth,
+so ``auto`` picks the measured winner (``SINGLE_DEVICE_PALLAS_GENS``)
+when that kernel will serve the run, K=1 otherwise (VERDICT r3 item 4).
 
 Reference anchor: the reference hardcodes the opposite extreme — one
 exchange and one barrier per generation, always
@@ -38,6 +40,14 @@ LATENCY_TABLE = ((30.0, 1), (150.0, 2), (600.0, 4), (float("inf"), 8))
 # a band deeper than tile_min/8 spends >~25% of compute on redundant
 # fringe (both sides, both axes) — cap K there
 FRINGE_DIVISOR = 8
+
+# Single-device radius-1 runs served by the fused SWAR kernel reinterpret
+# comm_every as the kernel's temporal-blocking depth (generations per HBM
+# round-trip); gens=8 is the measured winner on hardware
+# (perf/engine_ladder.json: +5% over gens=1 at 65536², PERF.md's
+# gens-ladder row) and what bench.py runs the flagship at.  LtL keeps
+# gens=1 until the ltl_gens_ladder hardware row lands (queued).
+SINGLE_DEVICE_PALLAS_GENS = 8
 
 
 def probe_collective_latency_us(mesh, reps: int = 5) -> float:
@@ -82,19 +92,25 @@ def choose_comm_policy(
     tile_cols: int,
     latency_us: float,
     overlap_requested: bool = False,
+    single_device_pallas: bool = False,
 ) -> Tuple[int, bool]:
     """(comm_every, overlap) for ``--comm-every auto``.
 
-    Single device: (1, overlap_requested) — today's behavior, nothing to
-    tune (the packed engine reinterprets K as kernel temporal blocking,
-    which bench.py sets explicitly where it wins).  Multi-device: K from
-    the latency table, clamped by the engine's halo bounds (K ≤ 16 at
-    radius 1, K·r ≤ 31 beyond) and the fringe budget (K·r ≤ tile_min/8);
-    rules that give birth on 0 neighbors cannot run deep halos at all.
-    ``overlap`` turns on whenever the stitched bands fit the tile
-    (hiding the exchange costs nothing but the fringe recompute that K
-    already budgeted)."""
+    Single device: ``(SINGLE_DEVICE_PALLAS_GENS, overlap_requested)``
+    when the fused radius-1 kernel will serve the run
+    (``single_device_pallas`` — the caller has checked the platform gate
+    and kernel ``supports()``; VERDICT r3 item 4: the measured winner,
+    not the un-blocked kernel), else (1, overlap_requested): off the
+    fused kernel there is no collective to avoid and no temporal
+    blocking to engage.  Multi-device: K from the latency table, clamped
+    by the engine's halo bounds (K ≤ 16 at radius 1, K·r ≤ 31 beyond)
+    and the fringe budget (K·r ≤ tile_min/8); rules that give birth on 0
+    neighbors cannot run deep halos at all.  ``overlap`` turns on
+    whenever the stitched bands fit the tile (hiding the exchange costs
+    nothing but the fringe recompute that K already budgeted)."""
     if n_devices <= 1:
+        if single_device_pallas and rule.radius == 1 and 0 not in rule.birth:
+            return SINGLE_DEVICE_PALLAS_GENS, overlap_requested
         return 1, overlap_requested
     r = rule.radius
     if 0 in rule.birth:
@@ -120,6 +136,20 @@ def resolve_auto(
     for multi-device runs)."""
     mi, mj = effective_mesh
     n = mi * mj
+    single_pallas = False
+    if n == 1 and config.rule.radius == 1 and 0 not in config.rule.birth:
+        # will the fused SWAR kernel serve this run at the measured-best
+        # temporal blocking depth?  Mirrors _pick_packed_evolve's
+        # single-device dispatch (backends/tpu.py) so auto's choice is
+        # what actually runs.
+        from mpi_tpu.backends.tpu import _pallas_single_device_mode
+        from mpi_tpu.ops.pallas_bitlife import supports
+
+        use, _ = _pallas_single_device_mode()
+        single_pallas = use and supports(
+            (config.rows, config.cols), config.rule,
+            gens=SINGLE_DEVICE_PALLAS_GENS,
+        )
     if n > 1 and latency_us is None:
         latency_us = probe_collective_latency_us(mesh)
         import jax
@@ -139,4 +169,5 @@ def resolve_auto(
         n, config.rule, config.rows // mi, config.cols // mj,
         latency_us if latency_us is not None else 0.0,
         overlap_requested=config.overlap,
+        single_device_pallas=single_pallas,
     )

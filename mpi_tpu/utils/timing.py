@@ -100,8 +100,15 @@ def write_reports(
     first: bool = False,
     out_dir: str = ".",
     all_durations=None,
+    extra=None,
 ) -> None:
     """Append the reference-schema pair of reports.
+
+    ``extra``: optional ordered mapping of column name → value appended
+    AFTER the reference's 12 CSV columns (sweep harnesses add
+    cells/s/device and weak-scaling efficiency); leading columns stay
+    byte-compatible with the reference schema, and plain runs (no
+    ``extra``) emit exactly the reference header.
 
     ``processes`` is the tile-writer count (devices/workers) reported in
     the #P column.  ``all_durations`` — a (P_proc, 3) array of per-process
@@ -143,8 +150,15 @@ def write_reports(
     compact = os.path.join(out_dir, f"{time_file}_compact.csv")
     with open(compact, "a") as f:
         if first:
-            f.write(CSV_HEADER)
-        f.write(
+            if extra:
+                f.write(CSV_HEADER.rstrip("\n")
+                        + "".join(f",{k}" for k in extra) + "\n")
+            else:
+                f.write(CSV_HEADER)
+        row = (
             f"{rows},{cols},{p},{full},{full_a},{full_s},"
-            f"{nos},{nos_a},{nos_s},{setup},{setup_a},{setup_s}\n"
+            f"{nos},{nos_a},{nos_s},{setup},{setup_a},{setup_s}"
         )
+        if extra:
+            row += "".join(f",{v}" for v in extra.values())
+        f.write(row + "\n")

@@ -458,6 +458,8 @@ def test_bench_no_deep_gens_on_dead_tunnel_bank_only(monkeypatch, capsys):
     def fake(argv, timeout, cpu=False):
         if argv[0] == "--probe":
             return {"platform": "tpu"}, "ok"
+        if argv[0] == "--mesh-child":
+            return None, "mesh rung not under test here"
         size, gens = int(argv[1]), int(argv[3])
         calls.append((size, gens))
         if size == bench.BANK_SIZE and gens == bench.GENS:
@@ -470,3 +472,61 @@ def test_bench_no_deep_gens_on_dead_tunnel_bank_only(monkeypatch, capsys):
     assert out["size"] == bench.BANK_SIZE
     assert all(g != bench.DEEP_GENS for _, g in calls), \
         "deep-gens attempt fired against a dead tunnel"
+
+
+def test_bench_mesh_rung_real_mesh(monkeypatch, capsys):
+    # >1 visible chip: the parent banks a real-mesh per-chip number
+    calls = []
+
+    def fake(argv, timeout, cpu=False):
+        calls.append((argv[0], cpu))
+        if argv[0] == "--probe":
+            return {"platform": "tpu", "n_devices": 8}, "ok"
+        if argv[0] == "--mesh-child":
+            assert argv[5] == "0"  # real devices, not virtual
+            return {"value": 1.6e13, "per_chip_value": 2.0e12,
+                    "mesh": [2, 4], "n_devices": 8, "gens": 8,
+                    "platform": "tpu", "virtual": False}, "ok"
+        return {"value": 2.0e12, "platform": "tpu", "size": int(argv[1])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["mesh"]["per_chip_value"] == 2.0e12
+    assert out["mesh"]["n_devices"] == 8
+    assert not out["mesh"]["virtual"]
+    assert ("--mesh-child", False) in calls
+
+
+def test_bench_mesh_rung_virtual_fallback(monkeypatch, capsys):
+    # one visible chip: the rung runs on the virtual CPU mesh instead,
+    # clearly labeled, and never degrades the single-chip metric
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu", "n_devices": 1}, "ok"
+        if argv[0] == "--mesh-child":
+            assert cpu and argv[5] == str(bench.MESH_VIRT_DEVICES)
+            return {"value": 9e8, "per_chip_value": 1.1e8,
+                    "mesh": [2, 4], "n_devices": 8, "gens": 1,
+                    "platform": "cpu", "virtual": True}, "ok"
+        return {"value": 2.0e12, "platform": "tpu", "size": int(argv[1])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert out["mesh"]["virtual"] is True
+    assert "degraded" not in out
+
+
+def test_bench_mesh_rung_failure_is_additive(monkeypatch, capsys):
+    # a failed mesh rung must cost nothing: no "mesh" field, single-chip
+    # metric untouched, failure recorded in the attempt history only
+    def fake(argv, timeout, cpu=False):
+        if argv[0] == "--probe":
+            return {"platform": "tpu", "n_devices": 8}, "ok"
+        if argv[0] == "--mesh-child":
+            return None, "timeout after 900s"
+        return {"value": 2.0e12, "platform": "tpu", "size": int(argv[1])}, "ok"
+
+    monkeypatch.setattr(bench, "run_sub", fake)
+    out = run_main(capsys)
+    assert "mesh" not in out
+    assert "degraded" not in out and out["value"] > 0

@@ -93,3 +93,26 @@ def test_ltl_gens_ladder_points_supported():
         assert gens <= max_gens(radius)
         assert supports((lad.SIDE, lad.SIDE), rule, gens=gens), (radius, gens)
         assert budget > 0
+
+
+def test_mosaic_smoke_variants_supported():
+    # every compile-smoke variant must pass the kernels' capability
+    # checks — a drifted shape would report a "compile regression" that
+    # is really a dispatch rejection (VERDICT r3 item 7)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mosaic_smoke",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "mosaic_smoke.py"))
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    full = ms.variants(quick=False)
+    quick = ms.variants(quick=True)
+    names = [n for n, _ in full]
+    assert len(names) == len(set(names))
+    assert len(quick) < len(full)
+    assert all(callable(t) for _, t in full)
+    # gated: no TPU here -> rc 2 and a JSON error line, nothing raised
+    assert ms.main([]) == 2

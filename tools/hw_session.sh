@@ -60,6 +60,19 @@ want=${1:-all}
 [ "$want" = all ] || [ "$want" = gens ] && \
   step gens python tools/ltl_gens_ladder.py
 
+# 4b. Mosaic compile-only smoke of every Pallas kernel variant (seconds;
+#     catches compile regressions even in a short tunnel window).
+[ "$want" = all ] || [ "$want" = mosaic ] && \
+  step mosaic python tools/mosaic_smoke.py
+
+# 4c. Weak-scaling rung on real hardware: with one visible chip this
+#     banks the 1-device row of the 8->256 ladder (ready to run as-is on
+#     a slice, where it ladders across the visible chips; VERDICT r3
+#     item 5).
+[ "$want" = all ] || [ "$want" = sweep ] && \
+  step sweep python tools/sweep.py --steps 100 --tile 8192 --comm-every 8 \
+    --jsonl perf/weakscale_hw.jsonl --out-dir perf --time-file weakscale_hw
+
 # 5. Hardware spot-check of the new Mosaic-compiled paths (overlap +
 #    gens) at product scale via the CLI: radius-2 gens dispatch and a
 #    bosco (r=5, bs_sum kernel) run, timed reports written to perf/.

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Mosaic compile-only smoke: compile (never run) every Pallas kernel
+variant on the real TPU, one JSON line each (VERDICT r3 item 7).
+
+The rewritten kernels are pinned by interpret-mode tests, but interpret
+mode never exercises Mosaic — a register-allocation or VMEM-accounting
+regression only surfaces at compile time on hardware.  This probe takes
+seconds per variant (lowering from ShapeDtypeStruct avals — no HBM
+traffic, no execution), so even a short tunnel window catches compile
+regressions across the whole kernel matrix.
+
+    python tools/mosaic_smoke.py            # full matrix
+    python tools/mosaic_smoke.py --quick    # one variant per kernel
+
+Exit 0 = every variant compiled; 1 = at least one failed (details in the
+JSON lines); 2 = no TPU reachable.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_tpu.utils.platform import apply_platform_override, probe_platform
+
+
+def variants(quick: bool):
+    """(name, build) pairs; build() returns a zero-arg compile thunk."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_tpu.models.rules import BOSCO, LIFE, rule_from_name
+    from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
+    from mpi_tpu.ops.pallas_bitltl import pallas_ltl_step
+    from mpi_tpu.ops.pallas_stencil import pallas_step
+
+    def aval(h, nw):
+        return jax.ShapeDtypeStruct((h, nw), jnp.uint32)
+
+    def bit(h, nw, boundary, gens):
+        def thunk():
+            jax.jit(
+                lambda p: pallas_bit_step(p, LIFE, boundary, gens=gens)
+            ).lower(aval(h, nw)).compile()
+
+        return thunk
+
+    def ltl(h, nw, rule, boundary, gens):
+        def thunk():
+            jax.jit(
+                lambda p: pallas_ltl_step(p, rule, boundary, gens=gens)
+            ).lower(aval(h, nw)).compile()
+
+        return thunk
+
+    def dense(h, w, boundary):
+        def thunk():
+            jax.jit(
+                lambda g: pallas_step(g, LIFE, boundary)
+            ).lower(jax.ShapeDtypeStruct((h, w), jnp.uint8)).compile()
+
+        return thunk
+
+    r2 = rule_from_name("R2,B10-13,S8-12")
+    # bench/production shapes: 8192² rung (NW=256) and the 65536²
+    # flagship (NW=2048, the compile-wall regime); sharded local tiles
+    # (8192x8192 per chip on a v5e-64) hit the same Mosaic artifacts.
+    out = [
+        ("bit-8192-p-g1", bit(8192, 256, "periodic", 1)),
+        ("bit-8192-p-g8", bit(8192, 256, "periodic", 8)),
+    ]
+    if quick:
+        return out + [("ltl-r2-16384-d-g1", ltl(16384, 512, r2, "dead", 1))]
+    out += [
+        ("bit-8192-d-g8", bit(8192, 256, "dead", 8)),
+        ("bit-8192-p-g16", bit(8192, 256, "periodic", 16)),
+        ("bit-65536-p-g8", bit(65536, 2048, "periodic", 8)),
+        ("ltl-r2-16384-p-g1", ltl(16384, 512, r2, "periodic", 1)),
+        ("ltl-r2-16384-d-g4", ltl(16384, 512, r2, "dead", 4)),
+        ("ltl-bosco-16384-p-g1", ltl(16384, 512, BOSCO, "periodic", 1)),
+        ("ltl-bosco-16384-d-g1", ltl(16384, 512, BOSCO, "dead", 1)),
+        ("dense-4096-p", dense(4096, 4096, "periodic")),
+    ]
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="one representative variant per kernel family")
+    p.add_argument("--json-out", default=None, metavar="PATH",
+                   help="also write the records to PATH (one JSON array)")
+    args = p.parse_args(argv)
+
+    apply_platform_override()
+    plat = probe_platform()
+    if plat != "tpu":
+        print(json.dumps({"error": f"no TPU (probe={plat})"}))
+        return 2
+
+    import jax
+
+    records = []
+    failed = 0
+    for name, thunk in variants(args.quick):
+        t0 = time.perf_counter()
+        try:
+            thunk()
+            rec = {"kernel": name, "ok": True,
+                   "compile_s": round(time.perf_counter() - t0, 2)}
+        except Exception as e:  # noqa: BLE001 — Mosaic errors vary by version
+            failed += 1
+            rec = {"kernel": name, "ok": False,
+                   "compile_s": round(time.perf_counter() - t0, 2),
+                   "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "variants": len(records), "failed": failed,
+    }))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(records, f, indent=1)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

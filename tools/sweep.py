@@ -75,6 +75,9 @@ def main(argv=None) -> int:
                    "(packed or dense engine, either boundary)")
     p.add_argument("--out-dir", default=".")
     p.add_argument("--time-file", default="sweep")
+    p.add_argument("--jsonl", default=None, metavar="PATH",
+                   help="also append each run's JSON record to this file "
+                   "(perf/weakscale_*.jsonl artifacts)")
     args = p.parse_args(argv)
 
     from mpi_tpu.models.rules import rule_from_name
@@ -113,10 +116,17 @@ def main(argv=None) -> int:
             overlap_active = (args.overlap
                               and args.tile >= 2 * args.comm_every * rule.radius)
         if packed:
+            # same fused-interior dispatch as the production runner: on a
+            # real TPU the tile interior runs through the Pallas kernel
+            # when the shard shape qualifies (VERDICT r3 item 1)
+            from mpi_tpu.backends.tpu import _pallas_single_device_mode
+
+            use_pl, interp = _pallas_single_device_mode()
             grid = sharded_bit_init(mesh, rows, cols, args.seed)
             evolve = make_sharded_bit_stepper(
                 mesh, rule, args.boundary, gens_per_exchange=args.comm_every,
-                overlap=args.overlap,
+                overlap=args.overlap, use_pallas=use_pl,
+                pallas_interpret=interp,
             )
         else:
             grid = sharded_init(mesh, rows, cols, args.seed)
@@ -137,16 +147,28 @@ def main(argv=None) -> int:
         if base_cps is None:
             base_cps = cps
         eff = cps / (n * base_cps) if base_cps else 0.0
+        cps_dev = cps / n
+        # efficiency + per-device throughput ride as extra columns after
+        # the reference's 12 (VERDICT r3 item 5: the 8->256 weak-scaling
+        # target needs an artifact computing efficiency, not just times)
         write_reports(args.time_file, timer, rows, cols, n,
-                      first=(i == 0), out_dir=args.out_dir)
-        print(json.dumps({
+                      first=(i == 0), out_dir=args.out_dir,
+                      extra={"cells/s/device": f"{cps_dev:.1f}",
+                             "weak eff": f"{eff:.4f}"})
+        record = {
             "devices": n, "mesh": list(shape), "grid": [rows, cols],
             "steps": args.steps, "engine": "bitpacked" if packed else "dense",
             "comm_every": args.comm_every,
             "overlap": bool(args.overlap and overlap_active),
             "cells_per_sec": round(cps, 1),
+            "cells_per_sec_per_device": round(cps_dev, 1),
             "weak_scaling_efficiency": round(eff, 4),
-        }))
+            "platform": jax.devices()[0].platform,
+        }
+        print(json.dumps(record))
+        if args.jsonl:
+            with open(args.jsonl, "a") as f:
+                f.write(json.dumps(record) + "\n")
     return 0
 
 

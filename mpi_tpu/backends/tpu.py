@@ -75,7 +75,8 @@ def _pallas_single_device_mode():
     return os.environ.get("MPI_TPU_PALLAS_INTERPRET") == "1", True
 
 
-def plan_pad_width(config: GolConfig, mj: int, fused_capable=None):
+def plan_pad_width(config: GolConfig, mj: int, fused_capable=None,
+                   shard_rows=None):
     """(cols_padded, pad_bits) — the pad-to-32 plan (VERDICT r3 item 3).
 
     A dead-boundary grid whose shard width is not word-aligned is padded
@@ -87,8 +88,10 @@ def plan_pad_width(config: GolConfig, mj: int, fused_capable=None):
     with modest waste the pad stretches to lane alignment (4096 cells
     per shard) so the fused Pallas interior qualifies too — but only
     when the platform can actually run it (``fused_capable``, defaulting
-    to the Pallas platform gate): off-TPU the stretch would compute up
-    to 25% extra columns the XLA engine gets nothing for.  Periodic
+    to the Pallas platform gate) AND, when ``shard_rows`` is supplied,
+    the kernel's shape predicate accepts the stretched shard: off-TPU or
+    on a kernel-rejected shape the stretch would compute up to 25% extra
+    columns the XLA engine gets nothing for.  Periodic
     grids are never padded: the wrap would have to cross a misaligned
     word boundary, which neither the word-shift SWAR arithmetic nor the
     kernels' lane rotation can express — they keep the dense engine.
@@ -104,8 +107,37 @@ def plan_pad_width(config: GolConfig, mj: int, fused_capable=None):
     if config.comm_every == 1 and fused_capable:
         lane = -(-shard // 4096) * 4096
         if lane * mj <= int(1.25 * config.cols):
-            cp_shard = lane
+            ok = True
+            if shard_rows is not None:
+                from mpi_tpu.parallel.step import (
+                    bit_local_pallas_ok, ltl_local_pallas_ok,
+                )
+
+                pred = (bit_local_pallas_ok if config.rule.radius == 1
+                        else ltl_local_pallas_ok)
+                ok = pred((shard_rows, lane // WORD), config.rule, 1)
+            if ok:
+                cp_shard = lane
     return cp_shard * mj, cp_shard * mj - config.cols
+
+
+def _segment_depths(segments, K: int):
+    """The local-step depths ``segmented_evolve`` will actually trace for
+    these segment lengths: each segment n runs ⌊n/k⌋ scans at depth
+    k = min(K, n) plus one remainder step at depth n % k.  The
+    compile-fallback's used_pallas gate is computed from THESE — a
+    depth never traced must not mark the program Pallas-bearing (a real
+    XLA compile error would otherwise pay a second identical compile
+    under a misleading fallback note)."""
+    depths = set()
+    for n in set(segments):
+        if n <= 0:
+            continue
+        k = max(1, min(K, n))
+        depths.add(k)
+        if n % k:
+            depths.add(n % k)
+    return depths
 
 
 def _shard_shape_packed(config: GolConfig, mesh, cols=None):
@@ -120,7 +152,7 @@ def _shard_shape_packed(config: GolConfig, mesh, cols=None):
 
 
 def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int,
-                        cols=None, pad_bits: int = 0):
+                        cols=None, pad_bits: int = 0, depths=None):
     """(stepper, used_pallas) for the packed engine: on a single device
     the fused Pallas SWAR kernel (ops/pallas_bitlife.py) replaces the
     shard_map/XLA path — no halo exchange exists, ``comm_every`` becomes
@@ -156,12 +188,14 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int,
         gens_per_exchange=config.comm_every, overlap=config.overlap,
         use_pallas=use, pallas_interpret=interpret, pad_bits=pad_bits,
     )
-    # the fused interior may serve any segment length k <= comm_every
-    # (segmented_evolve's remainder segments), so the compile-fallback
-    # must treat the stepper as Pallas-bearing if ANY depth qualifies;
-    # padded runs take the fused interior only at depth 1
+    # the compile-fallback must treat the stepper as Pallas-bearing iff
+    # a depth that will actually be traced takes the fused interior;
+    # padded runs take it only at depth 1
     shard = _shard_shape_packed(config, mesh, cols)
-    depths = (1,) if pad_bits else range(1, config.comm_every + 1)
+    if depths is None:
+        depths = range(1, config.comm_every + 1)  # conservative superset
+    if pad_bits:
+        depths = [k for k in depths if k == 1]
     used = use and any(
         bit_local_pallas_ok(shard, config.rule, k) for k in depths
     )
@@ -357,8 +391,16 @@ def run_tpu(
     # outputs crop back to the real width.
     from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
 
-    cols_eff, pad_bits = plan_pad_width(config, mj)
+    cols_eff, pad_bits = plan_pad_width(config, mj,
+                                        shard_rows=config.rows // mi)
     packed_mode = config.rule.radius == 1 and (cols_eff // mj) % WORD == 0
+    # the segment plan (and so the set of stepper depths that will be
+    # traced) is known up front — the Pallas compile-fallback gate is
+    # computed from the depths that actually run
+    want_snapshots = snapshot_cb is not None and config.snapshot_every > 0
+    segments = plan_segments(
+        config.steps, config.snapshot_every if want_snapshots else 0)
+    seg_depths = _segment_depths(segments, config.comm_every)
     # radius > 1: the packed bit-sliced LtL engine replaces the dense path
     # when it applies (same packed init/snapshot plumbing) — the fused
     # Pallas kernel on one device, the shard_map/ppermute XLA stepper on
@@ -385,10 +427,13 @@ def run_tpu(
             "comm_every 1 here)",
             file=sys.stderr,
         )
-    if config.overlap and mi * mj > 1:
+    if config.overlap and mi * mj > 1 \
+            and not (pad_bits and config.comm_every > 1):
         # fail fast instead of silently running without the requested
         # overlap: tiles must be big enough for the stitched edge bands
-        # (judged on the effective — padded — geometry)
+        # (judged on the effective — padded — geometry).  Padded K>1 runs
+        # already dropped the overlap above — no bands will be built, so
+        # the band-size check must not reject them.
         from mpi_tpu.config import ConfigError
 
         tile_r, tile_c = config.rows // mi, cols_eff // mj
@@ -440,13 +485,15 @@ def run_tpu(
                 use_pallas=use, pallas_interpret=interpret, pad_bits=pad_bits,
             )
             shard = _shard_shape_packed(config, mesh, cols_eff)
-            depths = (1,) if pad_bits else range(1, config.comm_every + 1)
+            depths = ([k for k in seg_depths if k == 1] if pad_bits
+                      else seg_depths)
             used_pallas = use and any(
                 ltl_local_pallas_ok(shard, config.rule, k) for k in depths
             )
         else:
             evolve, used_pallas = _pick_packed_evolve(
                 config, mesh, mi * mj, cols=cols_eff, pad_bits=pad_bits,
+                depths=seg_depths,
             )
         if initial is not None:
             grid = _put_initial(mesh, initial, config.rows, cols_eff, True,
@@ -463,11 +510,10 @@ def run_tpu(
         else:
             grid = sharded_init(mesh, config.rows, config.cols, config.seed)
 
-    want_snapshots = snapshot_cb is not None and config.snapshot_every > 0
-    segments = plan_segments(config.steps, config.snapshot_every if want_snapshots else 0)
-
     # Compile every distinct segment length ahead of time: compilation is
     # "setup", steady-state stepping is what throughput is measured on.
+    # (want_snapshots/segments were computed before engine selection —
+    # the fallback gate needs the traced depths.)
     def compile_segments(ev):
         return {n: ev.lower(grid, n).compile() for n in sorted(set(segments))}
 

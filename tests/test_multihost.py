@@ -208,3 +208,16 @@ def test_multihost_comm_every_auto_agrees(tmp_path):
     final = golio.assemble(str(tmp_path), name, 16)
     ref = evolve_np(init_tile_np(64, 256, seed=5), 16, LIFE, "periodic")
     np.testing.assert_array_equal(final, ref)
+
+
+def test_multihost_fused_interior(tmp_path, monkeypatch):
+    # round-4 fused-interior dispatch under jax.distributed: 2 processes
+    # x 2 devices with lane-aligned shard widths (8192 cells = 256 words
+    # per shard on the (2,2) mesh) run the Pallas tile interiors
+    # (interpret mode here) inside the multihost shard_map program, and
+    # the assembled tiles must match the oracle bit-for-bit
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    _run_group(str(tmp_path), ["16", "16384", "4", "4", "--name", "fusedmh"])
+    final = golio.assemble(str(tmp_path), "fusedmh", 4)
+    ref = evolve_np(init_tile_np(16, 16384, seed=5), 4, LIFE, "periodic")
+    np.testing.assert_array_equal(final, ref)

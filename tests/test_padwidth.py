@@ -107,6 +107,65 @@ def test_periodic_nonaligned_stays_dense(capsys):
     assert mode is None and "periodic wrap" in note
 
 
+def test_segment_depths_exact():
+    # the compile-fallback gate must see the depths segmented_evolve will
+    # actually trace, not a 1..K guess (code-review r4)
+    from mpi_tpu.backends.tpu import _segment_depths
+
+    assert _segment_depths([8], 4) == {4}
+    assert _segment_depths([10], 4) == {4, 2}
+    assert _segment_depths([3], 4) == {3}
+    assert _segment_depths([4, 4, 2], 4) == {4, 2}
+    assert _segment_depths([7], 1) == {1}
+
+
+def test_padded_k_gt1_used_pallas_false(monkeypatch):
+    # padded run, comm_every=4, steps=8, no snapshots: only depth-4
+    # segments are traced and pad forces them onto the Pallas-free
+    # exchange-all body — used_pallas must be False so a genuine compile
+    # error re-raises instead of paying a second identical compile
+    from mpi_tpu.backends import tpu as tpu_mod
+    from mpi_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(tpu_mod, "_pallas_single_device_mode",
+                        lambda: (True, True))
+    cfg = GolConfig(rows=32, cols=66, steps=8, boundary="dead",
+                    mesh_shape=(1, 2), comm_every=4)
+    _, used = tpu_mod._pick_packed_evolve(
+        cfg, make_mesh((1, 2)), 2, cols=128, pad_bits=62, depths={4})
+    assert not used
+    # with a depth-1 segment in the plan, the fused interior CAN engage
+    # (lane-aligned shard) and the gate must say so
+    cfg2 = GolConfig(rows=32, cols=16384, steps=8, boundary="periodic",
+                     mesh_shape=(1, 2), comm_every=4)
+    _, used2 = tpu_mod._pick_packed_evolve(
+        cfg2, make_mesh((1, 2)), 2, depths={4, 1})
+    assert used2
+
+
+def test_plan_pad_lane_stretch_needs_kernel_shape():
+    # lane stretch must be withheld when the kernel's shape predicate
+    # rejects the stretched shard (rows too few): word alignment alone
+    # serves the XLA engine without the wasted columns
+    cfg = GolConfig(rows=4, cols=3990, steps=1, boundary="dead")
+    assert plan_pad_width(cfg, 1, fused_capable=True,
+                          shard_rows=4) == (4000, 10)
+    assert plan_pad_width(cfg, 1, fused_capable=True,
+                          shard_rows=32) == (4096, 106)
+
+
+def test_padded_overlap_k2_small_tile_runs_with_note(capsys):
+    # code-review r4: padded K>1 + --overlap on tiles too small for the
+    # stitched bands must RUN on the exchange-all body (with the dropped
+    # note), not contradict the note with a band-size ConfigError
+    cfg = GolConfig(rows=32, cols=40, steps=4, boundary="dead",
+                    mesh_shape=(1, 2), seed=23, comm_every=2, overlap=True)
+    out = run_tpu(cfg)  # padded tile_c = 32 < 2*WORD: old guard raised
+    ref = evolve_np(init_tile_np(32, 40, seed=23), 4, LIFE, "dead")
+    np.testing.assert_array_equal(out, ref)
+    assert "--overlap dropped" in capsys.readouterr().err
+
+
 def test_padded_overlap_k2_notes_drop(capsys):
     # code-review r4: a padded width at K > 1 cannot run the stitched
     # bands (the pad mask lives in the exchange-all loop) — the overlap

@@ -177,12 +177,25 @@ def main() -> int:
     ap.add_argument("--measure-roof", action="store_true",
                     help="measure the throughput roof on the current "
                     "device first (run on real hardware) and use it")
+    ap.add_argument("--allow-cpu-roof", action="store_true",
+                    help="let --measure-roof proceed on a non-TPU "
+                    "platform (default: refuse — a CPU 'roof' silently "
+                    "rewrites the committed hardware roofline artifact)")
     ap.add_argument("--ladder",
                     default=os.path.join(repo, "perf", "engine_ladder.json"))
     ap.add_argument("--out",
                     default=os.path.join(repo, "perf", "roofline.json"))
     args = ap.parse_args()
     if args.measure_roof:
+        if not args.allow_cpu_roof:
+            from mpi_tpu.utils.platform import probe_platform
+
+            plat = probe_platform()
+            if plat != "tpu":
+                print(f"error: --measure-roof needs the real chip "
+                      f"(probe platform={plat!r}); pass --allow-cpu-roof "
+                      f"to override", file=sys.stderr)
+                return 1
         args.roof = measure_roof()
         print(f"measured throughput roof: {args.roof:.3g} lane-ops/s")
 

@@ -40,6 +40,7 @@ targets >= 1e11 cells/s on v5e-64, i.e. 1.5625e9 per chip.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -276,10 +277,26 @@ def run_sub(argv, timeout: float, cpu: bool = False):
         return None, f"unparseable child output: {proc.stdout[-200:]!r}"
 
 
+# Attempt notes accumulate here (not in a _main_inner local) so the
+# crash/SIGTERM guard in main() can still flush a partial history.
+_HISTORY = []
+
+
 def main() -> None:
     # Nothing may escape: the driver's capture is the only perf evidence
     # that counts, so even an unexpected parent-side error (fork failure,
     # malformed child output shape, ...) must still yield the JSON line.
+    # SIGTERM (hw_session.sh's step timeout sends TERM before KILL) must
+    # route through the same guard so the attempt history still flushes.
+    def _on_term(signum, frame):
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    # per-run reset: an interrupt BEFORE _main_inner takes this run's
+    # snapshot must fall back to the disk load, not a previous run's
+    # (possibly emptier) snapshot
+    global _PRIOR_FLAGSHIP
+    _PRIOR_FLAGSHIP = _LOAD_FROM_DISK
     try:
         out, history = _main_inner()
     except BaseException as e:  # noqa: BLE001
@@ -290,12 +307,18 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": f"bench harness error: {type(e).__name__}: {e}"[:500],
         }
-        history = []
+        # the attempts gathered before the interrupt (probe notes, banked
+        # rungs) are the evidence of what the run got through — keep them
+        history = list(_HISTORY)
         try:
             # even the worst failure mode must carry the hardware evidence
-            _attach_verified(out)
+            # (the start-of-run snapshot, not a post-bank disk read)
+            _attach_verified(out, prior=_PRIOR_FLAGSHIP)
         except BaseException:  # noqa: BLE001
             pass
+    # past the point of useful interruption: a TERM landing inside the
+    # artifact write or the stdout print would only destroy evidence
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
     _write_artifact(out, history)
     print(json.dumps(out))
 
@@ -307,6 +330,25 @@ def _perf_path(env_key: str, filename: str) -> str:
 
 def _verified_path() -> str:
     return _perf_path("MPI_TPU_BENCH_VERIFIED", "bench_tpu_verified.json")
+
+
+def _atomic_json_dump(path: str, obj) -> None:
+    """tmp + os.replace so a kill or disk-full mid-write cannot truncate
+    the existing file.  Cleans up the .tmp on ANY failure — BaseException
+    because the SIGTERM handler raises SystemExit at arbitrary points,
+    including mid-json.dump — then re-raises."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:  # noqa: BLE001
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _record_verified(out, history=None) -> None:
@@ -328,20 +370,7 @@ def _record_verified(out, history=None) -> None:
         payload = dict(out)
         payload["measured_at_unix"] = int(time.time())
         recs[key] = payload
-        path = _verified_path()
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump({"records": recs}, f, indent=1)
-            os.replace(tmp, path)
-        except OSError:
-            # never leave a half-written .tmp in the committed perf/ dir
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        _atomic_json_dump(_verified_path(), {"records": recs})
     except OSError as e:
         if history is not None:
             history.append(f"persist-error:{type(e).__name__}: {e}"[:160])
@@ -393,23 +422,34 @@ def _write_artifact(out, history) -> None:
     # after the driver's round-end bench run is meant to be committed as
     # part of the round's perf record.
     try:
-        here = os.path.dirname(os.path.abspath(__file__))
-        path = _perf_path("MPI_TPU_BENCH_ARTIFACT", "bench_last.json")
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"result": out, "attempts": history}, f, indent=1)
+        _atomic_json_dump(
+            _perf_path("MPI_TPU_BENCH_ARTIFACT", "bench_last.json"),
+            {"result": out, "attempts": history})
     except OSError:
         pass
 
 
+def _persist_tpu(res, history) -> None:
+    """Persist a landed measurement as hardware evidence immediately —
+    hw_session's step timeout may TERM this process at any point, and a
+    measured TPU rung must survive that.  One place for the condition so
+    the bank/ladder/recovery/g16 sites cannot drift."""
+    if res.get("platform") == "tpu":
+        _record_verified(_clean_record(res), history)
+
+
 def _main_inner():
-    history = []
+    global _PRIOR_FLAGSHIP
+    history = _HISTORY  # module-level so the SIGTERM guard can flush it
+    history.clear()  # repeated main() calls must not leak earlier notes
     result = None
     # snapshot the flagship evidence BEFORE this capture records anything:
     # attached "prior" evidence must be genuinely prior (a first-ever run
     # that banks 8192^2 must not see its own record labeled "NOT produced
-    # by this run")
-    prior_flagship = _load_verified()
+    # by this run").  Shared with the crash/SIGTERM guard via the module
+    # global — the guard fires mid-run, AFTER this capture may have
+    # recorded, so loading from disk there would break the same invariant.
+    _PRIOR_FLAGSHIP = prior_flagship = _load_verified()
 
     # 1. Reachability probe: a dead tunnel hangs jax.devices(), so find out
     #    cheaply instead of burning the ladder's long timeouts on it.
@@ -450,7 +490,7 @@ def _main_inner():
         history.append(f"bank-{BANK_SIZE}:{note[:160]}")
         if res is not None and res.get("platform") == "tpu":
             bank = res
-            _record_verified(_clean_record(res), history)
+            _persist_tpu(res, history)
 
     # 3. Size ladder on the real device, largest (flagship) first.  The
     #    banked rung already covers BANK_SIZE; it re-enters the ladder
@@ -470,6 +510,7 @@ def _main_inner():
                 history.append(f"{size}:{note[:160]}")
                 if res is not None:
                     result = res
+                    _persist_tpu(res, history)
                     break
                 if i + 1 < ATTEMPTS_PER_SIZE:
                     time.sleep(BACKOFF_S[min(i, len(BACKOFF_S) - 1)])
@@ -492,6 +533,7 @@ def _main_inner():
         history.append(f"recovery-{SIZES[0]}:{note[:160]}")
         if res is not None:
             result = res
+            _persist_tpu(res, history)
 
     # 3b. The banked rung is the floor: a failed climb still reports a
     #     real TPU measurement from this capture.
@@ -518,6 +560,7 @@ def _main_inner():
         history.append(f"{result['size']}g{DEEP_GENS}:{note[:160]}")
         if res is not None and res["value"] > result["value"]:
             result = res
+            _persist_tpu(res, history)
 
     # 4. Degraded CPU measurement if the TPU path produced nothing.
     degraded = None
@@ -591,17 +634,16 @@ def _main_inner():
     if result is None:
         out["error"] = "all attempts failed"
         out["attempts"] = history
-    # record BEFORE attaching, and only the measurement fields: the
-    # verified file must hold clean evidence — never nested prior
-    # records, nor this capture's run-specific note/degraded fields
-    if result is not None and result.get("platform") == "tpu":
-        _record_verified(_clean_record(result), history)
     if degraded or note_field or result is None:
         _attach_verified(out, prior=prior_flagship)
     return out, history
 
 
 _LOAD_FROM_DISK = object()  # "no snapshot taken" — distinct from prior=None
+
+# Start-of-run flagship snapshot, set by _main_inner so the crash/SIGTERM
+# guard attaches genuinely-prior evidence even after this run recorded.
+_PRIOR_FLAGSHIP = _LOAD_FROM_DISK
 
 
 def _clean_record(res) -> dict:
@@ -625,11 +667,13 @@ def _attach_verified(out, prior=_LOAD_FROM_DISK) -> None:
     # a dead tunnel at capture time must not erase the hardware
     # evidence: attach the persisted best undegraded TPU measurement,
     # clearly labeled as prior (its measured_at_unix timestamps it).
-    # Callers that recorded during this capture pass the start-of-run
-    # snapshot — which may legitimately be None on a first-ever run, so
-    # the "load from disk" default is a distinct sentinel (this run's
-    # own fresh record must never be labeled prior) — while the crash
-    # guard, which recorded nothing, loads from disk.
+    # Every caller that may fire AFTER this capture recorded — the
+    # normal end-of-run paths AND the crash/SIGTERM guard (which can
+    # interrupt mid-ladder, after the bank persisted) — passes the
+    # start-of-run snapshot, which may legitimately be None on a
+    # first-ever run; the "load from disk" sentinel default exists only
+    # for a failure before _main_inner takes that snapshot.  This run's
+    # own fresh record must never be labeled prior.
     if prior is _LOAD_FROM_DISK:
         prior = _load_verified()
     if prior is not None:

@@ -20,6 +20,7 @@ import jax
 from mpi_tpu.config import GolConfig, plan_segments
 from mpi_tpu.parallel.mesh import make_mesh
 from mpi_tpu.parallel.step import grid_sharding, make_sharded_stepper, sharded_init
+from mpi_tpu.utils.segmenting import segment_depths
 from mpi_tpu.utils.timing import PhaseTimer
 
 SnapshotCb = Callable[[int, List[Tuple[int, np.ndarray, int, int]]], None]
@@ -119,25 +120,6 @@ def plan_pad_width(config: GolConfig, mj: int, fused_capable=None,
             if ok:
                 cp_shard = lane
     return cp_shard * mj, cp_shard * mj - config.cols
-
-
-def _segment_depths(segments, K: int):
-    """The local-step depths ``segmented_evolve`` will actually trace for
-    these segment lengths: each segment n runs ⌊n/k⌋ scans at depth
-    k = min(K, n) plus one remainder step at depth n % k.  The
-    compile-fallback's used_pallas gate is computed from THESE — a
-    depth never traced must not mark the program Pallas-bearing (a real
-    XLA compile error would otherwise pay a second identical compile
-    under a misleading fallback note)."""
-    depths = set()
-    for n in set(segments):
-        if n <= 0:
-            continue
-        k = max(1, min(K, n))
-        depths.add(k)
-        if n % k:
-            depths.add(n % k)
-    return depths
 
 
 def _shard_shape_packed(config: GolConfig, mesh, cols=None):
@@ -400,7 +382,7 @@ def run_tpu(
     want_snapshots = snapshot_cb is not None and config.snapshot_every > 0
     segments = plan_segments(
         config.steps, config.snapshot_every if want_snapshots else 0)
-    seg_depths = _segment_depths(segments, config.comm_every)
+    seg_depths = segment_depths(segments, config.comm_every)
     # radius > 1: the packed bit-sliced LtL engine replaces the dense path
     # when it applies (same packed init/snapshot plumbing) — the fused
     # Pallas kernel on one device, the shard_map/ppermute XLA stepper on

@@ -42,3 +42,23 @@ def segmented_evolve(make_local, K: int):
         return grid
 
     return evolve
+
+
+def segment_depths(segments, K: int):
+    """The local-step depths ``segmented_evolve`` will actually trace for
+    these segment lengths: each segment n runs ⌊n/k⌋ scans at depth
+    k = min(K, n) plus one remainder step at depth n % k.  Lives beside
+    ``segmented_evolve`` so the clamp/divmod plan cannot drift from the
+    one consumer that predicts it (the TPU backend's compile-fallback
+    used_pallas gate — a depth never traced must not mark the program
+    Pallas-bearing, or a real XLA compile error pays a second identical
+    compile under a misleading fallback note)."""
+    depths = set()
+    for n in set(segments):
+        if n <= 0:
+            continue
+        k = max(1, min(K, n))
+        depths.add(k)
+        if n % k:
+            depths.add(n % k)
+    return depths

@@ -110,7 +110,7 @@ def test_periodic_nonaligned_stays_dense(capsys):
 def test_segment_depths_exact():
     # the compile-fallback gate must see the depths segmented_evolve will
     # actually trace, not a 1..K guess (code-review r4)
-    from mpi_tpu.backends.tpu import _segment_depths
+    from mpi_tpu.utils.segmenting import segment_depths as _segment_depths
 
     assert _segment_depths([8], 4) == {4}
     assert _segment_depths([10], 4) == {4, 2}

@@ -12,8 +12,19 @@ cd "$(dirname "$0")/.."
 LOGS=perf/hw_session_logs
 mkdir -p "$LOGS"
 
+# A degrading tunnel hangs RPCs rather than failing them (observed
+# 2026-07-31: probe and a ladder child both blocked indefinitely), so
+# both the gate and every step run under a hard timeout — a stuck step
+# must not eat the rest of a healthy window.
+PROBE_TIMEOUT=${HW_PROBE_TIMEOUT:-170}
+STEP_TIMEOUT=${HW_STEP_TIMEOUT:-1800}
+# bench.py budgets its own probe window + bank + 2 flagship attempts +
+# g16 + mesh rungs (~6000s worst case while still progressing), so its
+# step gets a larger allowance than the single-measurement tools.
+BENCH_TIMEOUT=${HW_BENCH_TIMEOUT:-7200}
+
 probe() {
-  python -c "from mpi_tpu.utils.platform import probe_platform; import sys; sys.exit(0 if probe_platform() == 'tpu' else 1)"
+  timeout "$PROBE_TIMEOUT" python -c "from mpi_tpu.utils.platform import probe_platform; import sys; sys.exit(0 if probe_platform() == 'tpu' else 1)"
 }
 
 FAILED=()
@@ -28,8 +39,15 @@ step() {  # step <name> <cmd...>
     fi
     exit 1
   fi
-  ( "$@" ) 2>&1 | tee "$LOGS/$name.log"
+  # TERM first so bench.py's crash-guard can flush its attempt history;
+  # KILL 60s later unsticks a truly hung RPC that ignores TERM.
+  local t="$STEP_TIMEOUT"
+  [ "$name" = bench ] && t="$BENCH_TIMEOUT"
+  ( timeout --kill-after=60 "$t" "$@" ) 2>&1 | tee "$LOGS/$name.log"
   local rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "hw_session: '$name' timed out after ${t}s (hung tunnel?)" >&2
+  fi
   echo "=== $name done (rc=$rc) ==="
   # later steps still run (bench failing must not block the ladders),
   # but a failed step must not vanish into an exit-0 "queue complete"

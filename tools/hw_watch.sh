@@ -18,7 +18,7 @@ MARK=perf/hw_watch.ran
 mkdir -p perf perf/hw_session_logs
 
 while true; do
-  plat=$(timeout 170 python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform())" 2>/dev/null | tail -1)
+  plat=$(timeout "${HW_PROBE_TIMEOUT:-170}" python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform())" 2>/dev/null | tail -1)
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe=${plat:-error}" >> "$LOG"
   if [ "${plat:-}" = "tpu" ] && [ ! -e "$MARK" ]; then
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel healthy — running hw_session" >> "$LOG"

@@ -4,8 +4,15 @@
 # orchestration.  Each step is independently resumable; artifacts land
 # under perf/ and logs under perf/hw_session_logs/.
 #
-#   bash tools/hw_session.sh            # run the full queue
-#   bash tools/hw_session.sh bench      # just one step
+# Steps are resumable ACROSS windows: a step that exits 0 drops a
+# .done marker (gitignored) and is skipped on the next full-queue run —
+# tunnel windows can be shorter than the queue (observed 2026-07-31:
+# ~10 min), so successive windows must make incremental progress
+# instead of re-measuring the head of the queue every time.  Naming a
+# step explicitly re-runs it regardless; HW_FORCE=1 re-runs everything.
+#
+#   bash tools/hw_session.sh            # run the full queue (resume)
+#   bash tools/hw_session.sh bench      # force just one step
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -18,19 +25,25 @@ mkdir -p "$LOGS"
 # must not eat the rest of a healthy window.
 PROBE_TIMEOUT=${HW_PROBE_TIMEOUT:-170}
 STEP_TIMEOUT=${HW_STEP_TIMEOUT:-1800}
-# bench.py budgets its own probe window + bank + 2 flagship attempts +
-# g16 + mesh rungs (~6000s worst case while still progressing), so its
-# step gets a larger allowance than the single-measurement tools.
-BENCH_TIMEOUT=${HW_BENCH_TIMEOUT:-7200}
+# bench.py budgets its own probe window + bank + ladder retries + CPU
+# fallback + mesh rungs (computed worst case ~9,900s with every child
+# timing out), so its step gets a larger allowance than the
+# single-measurement tools.
+BENCH_TIMEOUT=${HW_BENCH_TIMEOUT:-10800}
 
 probe() {
-  timeout "$PROBE_TIMEOUT" python -c "from mpi_tpu.utils.platform import probe_platform; import sys; sys.exit(0 if probe_platform() == 'tpu' else 1)"
+  timeout --kill-after=30 "$PROBE_TIMEOUT" python -c "from mpi_tpu.utils.platform import probe_platform; import sys; sys.exit(0 if probe_platform() == 'tpu' else 1)"
 }
 
 FAILED=()
 
 step() {  # step <name> <cmd...>
   local name=$1; shift
+  if [ "$want" = all ] && [ "${HW_FORCE:-0}" != 1 ] \
+      && [ -e "$LOGS/$name.done" ]; then
+    echo "=== hw_session: $name already done (rm $LOGS/$name.done to redo) ==="
+    return 0
+  fi
   echo "=== hw_session: $name ==="
   if ! probe; then
     echo "hw_session: tunnel not answering before '$name' — stopping" >&2
@@ -39,6 +52,8 @@ step() {  # step <name> <cmd...>
     fi
     exit 1
   fi
+  local start_stamp
+  start_stamp=$(mktemp)
   # TERM first so bench.py's crash-guard can flush its attempt history;
   # KILL 60s later unsticks a truly hung RPC that ignores TERM.
   local t="$STEP_TIMEOUT"
@@ -49,9 +64,33 @@ step() {  # step <name> <cmd...>
     echo "hw_session: '$name' timed out after ${t}s (hung tunnel?)" >&2
   fi
   echo "=== $name done (rc=$rc) ==="
+  # bench.py exits 0 BY CONTRACT even when every TPU attempt failed
+  # (degraded CPU fallback) or only a bank-size rung landed — "done"
+  # must mean a FRESH undegraded TPU flagship, or a dead window would
+  # permanently skip the flagship re-measure (the artifact ships in the
+  # tree, hence the freshness stamp; the path honors bench.py's env
+  # override)
+  local bench_art="${MPI_TPU_BENCH_ARTIFACT:-perf/bench_last.json}"
+  if [ "$rc" -eq 0 ] && [ "$name" = bench ] && {
+      ! [ "$bench_art" -nt "$start_stamp" ] || ! python - "$bench_art" <<'PY'
+import json, sys
+import bench  # repo root is the cwd; flagship size stays defined once
+try:
+    d = json.load(open(sys.argv[1]))["result"]
+except Exception:
+    sys.exit(1)
+ok = (d.get("platform") == "tpu" and "degraded" not in d
+      and "note" not in d and d.get("size") == bench.SIZES[0])
+sys.exit(0 if ok else 1)
+PY
+  }; then
+    echo "hw_session: bench banked no fresh undegraded TPU flagship — not marking done" >&2
+    rc=1
+  fi
+  rm -f "$start_stamp"
   # later steps still run (bench failing must not block the ladders),
   # but a failed step must not vanish into an exit-0 "queue complete"
-  if [ "$rc" -ne 0 ]; then FAILED+=("$name"); fi
+  if [ "$rc" -ne 0 ]; then FAILED+=("$name"); else touch "$LOGS/$name.done"; fi
   return 0
 }
 

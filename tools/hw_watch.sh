@@ -18,21 +18,24 @@ MARK=perf/hw_watch.ran
 mkdir -p perf perf/hw_session_logs
 
 while true; do
-  plat=$(timeout "${HW_PROBE_TIMEOUT:-170}" python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform())" 2>/dev/null | tail -1)
+  plat=$(timeout --kill-after=30 "${HW_PROBE_TIMEOUT:-170}" python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform())" 2>/dev/null | tail -1)
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe=${plat:-error}" >> "$LOG"
   if [ "${plat:-}" = "tpu" ] && [ ! -e "$MARK" ]; then
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel healthy — running hw_session" >> "$LOG"
-    start_stamp=$(mktemp)
-    bash tools/hw_session.sh > perf/hw_session_logs/hw_watch_run.log 2>&1
+    # append with a window header: the queue spans multiple windows by
+    # design, and a later degrading window must not erase the record of
+    # the one that banked results
+    echo "===== hw_watch window $(date -u +%Y-%m-%dT%H:%M:%SZ) =====" \
+      >> perf/hw_session_logs/hw_watch_run.log
+    bash tools/hw_session.sh >> perf/hw_session_logs/hw_watch_run.log 2>&1
     rc=$?
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) hw_session exited rc=$rc" >> "$LOG"
-    # only mark done when the queue actually got through the bench step:
-    # bench_last.json ships in the tree, so require it FRESHER than the
-    # session start, not merely present
-    if [ $rc -eq 0 ] && [ perf/bench_last.json -nt "$start_stamp" ]; then
+    # rc=0 now means every step either succeeded this window or holds a
+    # .done marker from a previous one (the queue resumes across short
+    # windows), so it is exactly the "program complete" condition
+    if [ $rc -eq 0 ]; then
       touch "$MARK"
     fi
-    rm -f "$start_stamp"
   fi
   sleep "$INTERVAL"
 done

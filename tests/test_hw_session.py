@@ -1,0 +1,182 @@
+"""The hardware-queue orchestration (tools/hw_session.sh) in a sandbox.
+
+The queue's resume/gate logic grew real invariants in round 4 — .done
+markers must mean what they claim, a degraded or bank-only bench must
+never mark done, a dead tunnel must stop the queue — and none of that
+needs a TPU to verify: the sandbox provides a stub
+``mpi_tpu.utils.platform.probe_platform`` (env-controlled) and mini
+step tools, and runs the real script with the real shell.
+"""
+
+import json
+import os
+import shutil
+import stat
+import subprocess
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+MINI_PLATFORM = """\
+import os
+def probe_platform():
+    return os.environ.get("FAKE_PROBE", "tpu")
+"""
+
+# the gate imports bench for SIZES[0]; the bench step writes an artifact
+# whose shape the test controls
+MINI_BENCH = """\
+import json, os, sys
+SIZES = (65536, 32768, 16384, 8192)
+if __name__ == "__main__":
+    res = json.loads(os.environ.get(
+        "FAKE_BENCH_RESULT",
+        '{"platform": "tpu", "size": 65536, "value": 1.0}'))
+    os.makedirs("perf", exist_ok=True)
+    with open("perf/bench_last.json", "w") as f:
+        json.dump({"result": res, "attempts": []}, f)
+    print(json.dumps(res))
+"""
+
+MINI_TOOL = """\
+import sys
+sys.exit(0)
+"""
+
+MINI_CLI = """\
+import sys
+if __name__ == "__main__":
+    sys.exit(0)
+"""
+
+
+@pytest.fixture()
+def sandbox(tmp_path):
+    os.makedirs(tmp_path / "tools")
+    shutil.copy(os.path.join(REPO, "tools", "hw_session.sh"),
+                tmp_path / "tools" / "hw_session.sh")
+    os.chmod(tmp_path / "tools" / "hw_session.sh",
+             os.stat(tmp_path / "tools" / "hw_session.sh").st_mode
+             | stat.S_IXUSR)
+    os.makedirs(tmp_path / "mpi_tpu" / "utils")
+    (tmp_path / "mpi_tpu" / "__init__.py").write_text("")
+    (tmp_path / "mpi_tpu" / "utils" / "__init__.py").write_text("")
+    (tmp_path / "mpi_tpu" / "utils" / "platform.py").write_text(
+        MINI_PLATFORM)
+    (tmp_path / "mpi_tpu" / "cli.py").write_text(MINI_CLI)
+    (tmp_path / "bench.py").write_text(MINI_BENCH)
+    for tool in ("roofline", "engine_ladder", "ltl_gens_ladder",
+                 "mosaic_smoke", "sweep"):
+        (tmp_path / "tools" / f"{tool}.py").write_text(MINI_TOOL)
+    os.makedirs(tmp_path / "perf")
+    return tmp_path
+
+
+def run_queue(sandbox, *args, env=None):
+    # the queue launches ~65 interpreters per run and the environment's
+    # sitecustomize costs ~0.4 s each; the sandbox only needs stdlib +
+    # cwd imports, so a `python -S` shim keeps each test a few seconds
+    import sys
+    bindir = sandbox / "bin"
+    if not bindir.exists():
+        os.makedirs(bindir)
+        shim = bindir / "python"
+        shim.write_text(f'#!/bin/sh\nexec "{sys.executable}" -S "$@"\n')
+        os.chmod(shim, 0o755)
+    full_env = dict(os.environ)
+    full_env.pop("MPI_TPU_BENCH_ARTIFACT", None)
+    full_env["PATH"] = f"{bindir}:{full_env['PATH']}"
+    full_env.update(env or {})
+    return subprocess.run(
+        ["bash", str(sandbox / "tools" / "hw_session.sh"), *args],
+        capture_output=True, text=True, timeout=120, cwd=sandbox,
+        env=full_env)
+
+
+def test_full_queue_marks_all_steps_done(sandbox):
+    proc = run_queue(sandbox)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    done = sorted(p.name for p in (sandbox / "perf" / "hw_session_logs")
+                  .glob("*.done"))
+    assert done == ["bench.done", "gens.done", "ladder.done",
+                    "mosaic.done", "roof.done", "spot-bosco.done",
+                    "spot-r2g4.done", "sweep.done"]
+
+
+def test_done_steps_are_skipped_next_window(sandbox):
+    run_queue(sandbox)
+    proc = run_queue(sandbox)
+    assert proc.returncode == 0
+    assert proc.stdout.count("already done") == 8
+
+
+def test_named_step_reruns_despite_marker(sandbox):
+    run_queue(sandbox)
+    proc = run_queue(sandbox, "roof")
+    assert proc.returncode == 0
+    assert "already done" not in proc.stdout
+    assert "=== roof done (rc=0) ===" in proc.stdout
+
+
+def test_degraded_bench_not_marked_done(sandbox):
+    proc = run_queue(sandbox, env={"FAKE_BENCH_RESULT": json.dumps(
+        {"platform": "cpu", "size": 8192, "value": 1.0,
+         "degraded": "tpu unreachable"})})
+    assert proc.returncode == 1
+    assert "not marking done" in proc.stdout + proc.stderr
+    assert not (sandbox / "perf" / "hw_session_logs" / "bench.done").exists()
+    # the rest of the queue still ran (bench failing must not block it)
+    assert (sandbox / "perf" / "hw_session_logs" / "roof.done").exists()
+
+
+def test_bank_only_bench_not_marked_done(sandbox):
+    # a window that dies after the 8192 bank: platform=tpu but a "note"
+    # and a non-flagship size — must NOT count as done
+    proc = run_queue(sandbox, env={"FAKE_BENCH_RESULT": json.dumps(
+        {"platform": "tpu", "size": 8192, "value": 1.0,
+         "note": "flagship rungs did not complete"})})
+    assert proc.returncode == 1
+    assert not (sandbox / "perf" / "hw_session_logs" / "bench.done").exists()
+
+
+def test_stale_artifact_not_marked_done(sandbox):
+    # bench writes nothing this run (artifact pre-exists, older than the
+    # step start) — freshness gate must refuse the marker
+    (sandbox / "perf" / "bench_last.json").write_text(json.dumps(
+        {"result": {"platform": "tpu", "size": 65536, "value": 1.0},
+         "attempts": []}))
+    (sandbox / "bench.py").write_text("pass\n")  # writes no artifact
+    proc = run_queue(sandbox)
+    assert proc.returncode == 1
+    assert not (sandbox / "perf" / "hw_session_logs" / "bench.done").exists()
+
+
+def test_dead_tunnel_stops_queue(sandbox):
+    proc = run_queue(sandbox, env={"FAKE_PROBE": "cpu"})
+    assert proc.returncode == 1
+    assert "tunnel not answering" in proc.stdout + proc.stderr
+    assert not list((sandbox / "perf" / "hw_session_logs").glob("*.done"))
+
+
+def test_failed_step_fails_queue_but_later_steps_run(sandbox):
+    (sandbox / "tools" / "roofline.py").write_text("import sys; sys.exit(3)\n")
+    proc = run_queue(sandbox)
+    assert proc.returncode == 1
+    assert "FAILED steps: roof" in proc.stdout + proc.stderr
+    assert not (sandbox / "perf" / "hw_session_logs" / "roof.done").exists()
+    assert (sandbox / "perf" / "hw_session_logs" / "ladder.done").exists()
+
+
+def test_markers_older_than_verdict_do_not_skip(sandbox):
+    # a new round rewrites VERDICT.md; markers from the previous round
+    # must not skip re-measuring the rewritten code
+    run_queue(sandbox)
+    os.utime(sandbox / "perf" / "hw_session_logs" / "roof.done",
+             (1, 1))  # ancient marker
+    (sandbox / "VERDICT.md").write_text("round N+1\n")
+    proc = run_queue(sandbox)
+    assert proc.returncode == 0
+    assert "=== roof done (rc=0) ===" in proc.stdout  # re-ran
+    # VERDICT.md postdates every first-run marker, so nothing skips
+    assert proc.stdout.count("already done") == 0

@@ -116,3 +116,143 @@ def test_mosaic_smoke_variants_supported():
     assert all(callable(t) for _, t in full)
     # gated: no TPU here -> rc 2 and a JSON error line, nothing raised
     assert ms.main([]) == 2
+
+
+def _ladder(monkeypatch, tmp_path, child_results,
+            rungs=(("a", 1), ("b", 2))):
+    """Run run_ladder with run_child stubbed to answer from the
+    child_results dict (rung tuple → row); returns
+    (results, unresolved, calls, out_path)."""
+    calls = []
+
+    def fake_child(script, rung, timeout):
+        calls.append(tuple(rung))
+        return dict(child_results[tuple(rung)])
+
+    monkeypatch.setattr(scan_common, "run_child", fake_child)
+    out = str(tmp_path / "ladder.json")
+    results, unresolved = scan_common.run_ladder(
+        "x.py", rungs, 10, out, lambda rung: {"engine": rung[0]})
+    return results, unresolved, calls, out
+
+
+def test_run_ladder_measures_and_persists(monkeypatch, tmp_path):
+    results, unresolved, calls, out = _ladder(monkeypatch, tmp_path, {
+        ("a", 1): {"engine": "a", "gcells_per_s": 5.0},
+        ("b", 2): {"engine": "b", "gcells_per_s": 7.0},
+    })
+    assert unresolved == 0 and len(calls) == 2
+    disk = json.load(open(out))
+    assert [r["gcells_per_s"] for r in disk] == [5.0, 7.0]
+
+
+def test_run_ladder_resume_skips_measured(monkeypatch, tmp_path):
+    # first window measures rung a, errors rung b; second window must
+    # re-run ONLY b (a's measurement is never redone)
+    res1, unres1, calls1, out = _ladder(monkeypatch, tmp_path, {
+        ("a", 1): {"engine": "a", "gcells_per_s": 5.0},
+        ("b", 2): {"error": "TIMEOUT>10s"},
+    })
+    assert unres1 == 1  # b is owed a retry -> caller exits nonzero
+
+    calls2 = []
+
+    def fake_child2(script, rung, timeout):
+        calls2.append(tuple(rung))
+        return {"engine": "b", "gcells_per_s": 7.0}
+
+    monkeypatch.setattr(scan_common, "run_child", fake_child2)
+    results, unresolved = scan_common.run_ladder(
+        "x.py", (("a", 1), ("b", 2)), 10, out,
+        lambda rung: {"engine": rung[0]})
+    assert calls2 == [("b", 2)]
+    assert unresolved == 0
+    assert [r.get("gcells_per_s") for r in results] == [5.0, 7.0]
+
+
+def test_run_ladder_exhausted_rung_stops_retrying(monkeypatch, tmp_path):
+    # a deterministically failing rung retries MAX_RUNG_ATTEMPTS times
+    # total, then its error row stands and the ladder resolves (rc=0) —
+    # the queue's .done markers must not livelock on it
+    always_fail = {
+        ("a", 1): {"engine": "a", "gcells_per_s": 5.0},
+        ("b", 2): {"error": "Mosaic compile failed"},
+    }
+    _, unres1, _, out = _ladder(monkeypatch, tmp_path, always_fail)
+    assert unres1 == 1
+
+    for expect_calls in (1, 0):  # second attempt, then exhausted
+        calls = []
+
+        def fake_child(script, rung, timeout, _calls=calls):
+            _calls.append(tuple(rung))
+            return {"error": "Mosaic compile failed"}
+
+        monkeypatch.setattr(scan_common, "run_child", fake_child)
+        results, unresolved = scan_common.run_ladder(
+            "x.py", (("a", 1), ("b", 2)), 10, out,
+            lambda rung: {"engine": rung[0]})
+        assert len(calls) == expect_calls
+        assert unresolved == 0  # second attempt exhausts; third never owed
+    err_row = [r for r in results if "error" in r][0]
+    assert err_row["_attempts"] == scan_common.MAX_RUNG_ATTEMPTS
+
+
+def test_run_ladder_keeps_pending_rows_on_disk(monkeypatch, tmp_path):
+    # resuming must never truncate later measured rungs out of the
+    # artifact while an earlier rung is being retried: the file holds
+    # ALL known rows at every point, so a TERM costs one rung at most
+    out = str(tmp_path / "ladder.json")
+    rungs = (("a", 1), ("b", 2), ("c", 3))
+    scan_common.write_out(out, [
+        {"engine": "a", "gcells_per_s": 5.0,
+         "_key": json.dumps({"engine": "a"}, sort_keys=True)},
+        {"engine": "b", "error": "TIMEOUT>10s", "_attempts": 1,
+         "_key": json.dumps({"engine": "b"}, sort_keys=True)},
+        {"engine": "c", "gcells_per_s": 9.0,
+         "_key": json.dumps({"engine": "c"}, sort_keys=True)},
+    ])
+
+    seen_during_b = {}
+
+    def fake_child(script, rung, timeout):
+        # while b re-measures, c's banked row must still be on disk
+        seen_during_b["rows"] = {r["engine"]: r
+                                 for r in json.load(open(out))}
+        return {"engine": "b", "gcells_per_s": 7.0}
+
+    monkeypatch.setattr(scan_common, "run_child", fake_child)
+    results, unresolved = scan_common.run_ladder(
+        "x.py", rungs, 10, out, lambda rung: {"engine": rung[0]})
+    assert unresolved == 0
+    assert seen_during_b["rows"]["c"]["gcells_per_s"] == 9.0
+    disk = {r["engine"]: r for r in json.load(open(out))}
+    assert disk["b"]["gcells_per_s"] == 7.0
+    assert disk["c"]["gcells_per_s"] == 9.0
+
+
+def test_resume_rows_invalidated_by_new_verdict(monkeypatch, tmp_path):
+    # a new round's VERDICT.md postdates the artifact: resume must start
+    # fresh (the rewritten code gets re-measured), mirroring the queue's
+    # .done-marker invalidation
+    out = str(tmp_path / "ladder.json")
+    key = json.dumps({"engine": "a"}, sort_keys=True)
+    scan_common.write_out(out, [
+        {"engine": "a", "gcells_per_s": 5.0, "_key": key}])
+    assert key in scan_common._resume_rows(out)  # artifact newer: honored
+    verdict = tmp_path / "VERDICT.md"
+    verdict.write_text("round N+1\n")
+    os.utime(out, (1, 1))  # artifact now predates the verdict
+    assert scan_common._resume_rows(out, str(verdict)) == {}
+    # and honored again once the artifact postdates the new verdict
+    os.utime(out, None)
+    assert key in scan_common._resume_rows(out, str(verdict))
+
+
+def test_ladder_exit_contract():
+    rows_ok = [{"engine": "a", "gcells_per_s": 1.0}]
+    rows_err = rows_ok + [{"engine": "b", "error": "x", "_attempts": 2}]
+    assert scan_common.ladder_exit("t", rows_ok, 0) == 0
+    # exhausted error rows are recorded evidence, not retry debt
+    assert scan_common.ladder_exit("t", rows_err, 0) == 0
+    assert scan_common.ladder_exit("t", rows_err, 1) == 1

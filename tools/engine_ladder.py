@@ -93,20 +93,15 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="perf/engine_ladder.json")
     args = p.parse_args(argv)
 
-    from scan_common import require_tpu, run_child, write_out
+    from scan_common import ladder_exit, require_tpu, run_ladder
 
     if not require_tpu():
         return 1
 
-    results = []
-    for name, budget in ENGINES:
-        res = run_child(__file__, (name, budget), args.timeout)
-        if "error" in res:
-            res = {"engine": name, **res}
-        results.append(res)
-        print(json.dumps(res), flush=True)
-        write_out(args.out, results)
-    return 0
+    results, unresolved = run_ladder(
+        __file__, ENGINES, args.timeout, args.out,
+        lambda rung: {"engine": rung[0]})
+    return ladder_exit("engine_ladder", results, unresolved)
 
 
 if __name__ == "__main__":

@@ -39,8 +39,11 @@ FAILED=()
 
 step() {  # step <name> <cmd...>
   local name=$1; shift
+  # a marker OLDER than VERDICT.md predates this round (the round driver
+  # writes a fresh VERDICT.md at each round boundary) — the rewritten
+  # code must be re-measured, so stale markers do not skip
   if [ "$want" = all ] && [ "${HW_FORCE:-0}" != 1 ] \
-      && [ -e "$LOGS/$name.done" ]; then
+      && [ "$LOGS/$name.done" -nt VERDICT.md ]; then
     echo "=== hw_session: $name already done (rm $LOGS/$name.done to redo) ==="
     return 0
   fi
@@ -52,8 +55,10 @@ step() {  # step <name> <cmd...>
     fi
     exit 1
   fi
-  local start_stamp
-  start_stamp=$(mktemp)
+  local start_stamp=""
+  if [ "$name" = bench ]; then
+    start_stamp=$(mktemp)  # only the bench freshness gate reads it
+  fi
   # TERM first so bench.py's crash-guard can flush its attempt history;
   # KILL 60s later unsticks a truly hung RPC that ignores TERM.
   local t="$STEP_TIMEOUT"
@@ -87,7 +92,7 @@ PY
     echo "hw_session: bench banked no fresh undegraded TPU flagship — not marking done" >&2
     rc=1
   fi
-  rm -f "$start_stamp"
+  [ -n "$start_stamp" ] && rm -f "$start_stamp"
   # later steps still run (bench failing must not block the ladders),
   # but a failed step must not vanish into an exit-0 "queue complete"
   if [ "$rc" -ne 0 ]; then FAILED+=("$name"); else touch "$LOGS/$name.done"; fi

@@ -82,20 +82,15 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="perf/ltl_gens_ladder.json")
     args = p.parse_args(argv)
 
-    from scan_common import require_tpu, run_child, write_out
+    from scan_common import ladder_exit, require_tpu, run_ladder
 
     if not require_tpu():
         return 1
 
-    results = []
-    for radius, gens, budget in POINTS:
-        res = run_child(__file__, (radius, gens, budget), args.timeout)
-        if "error" in res:
-            res = {"engine": f"ltl-r{radius}-g{gens}", **res}
-        results.append(res)
-        print(json.dumps(res), flush=True)
-        write_out(args.out, results)
-    return 0
+    results, unresolved = run_ladder(
+        __file__, POINTS, args.timeout, args.out,
+        lambda rung: {"engine": f"ltl-r{rung[0]}-g{rung[1]}"})
+    return ladder_exit("ltl_gens_ladder", results, unresolved)
 
 
 if __name__ == "__main__":

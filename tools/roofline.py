@@ -205,8 +205,9 @@ def main() -> int:
 
     rows = []
     for entry in ladder:
-        name = entry["engine"]
-        if name not in opc:
+        name = entry.get("engine")
+        if name not in opc or "gcells_per_s" not in entry:
+            # error rows (failed/exhausted rungs) carry no measurement
             continue
         ops, basis = opc[name]
         tput = entry["gcells_per_s"] * 1e9

@@ -67,7 +67,7 @@ def sandbox(tmp_path):
     (tmp_path / "mpi_tpu" / "cli.py").write_text(MINI_CLI)
     (tmp_path / "bench.py").write_text(MINI_BENCH)
     for tool in ("roofline", "engine_ladder", "ltl_gens_ladder",
-                 "mosaic_smoke", "sweep"):
+                 "mosaic_smoke", "fused_stepper_check", "sweep"):
         (tmp_path / "tools" / f"{tool}.py").write_text(MINI_TOOL)
     os.makedirs(tmp_path / "perf")
     return tmp_path
@@ -99,16 +99,22 @@ def test_full_queue_marks_all_steps_done(sandbox):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     done = sorted(p.name for p in (sandbox / "perf" / "hw_session_logs")
                   .glob("*.done"))
-    assert done == ["bench.done", "gens.done", "ladder.done",
+    assert done == ["bench.done", "fused.done", "gens.done", "ladder.done",
                     "mosaic.done", "roof.done", "spot-bosco.done",
                     "spot-r2g4.done", "sweep.done"]
+    # cheapest/highest-information first (VERDICT r4 item 2): a ~10-min
+    # window must bank bench + the compile smoke + the fused parity run
+    # before any multi-minute ladder starts
+    order = [ln.split()[2] for ln in proc.stdout.splitlines()
+             if ln.startswith("=== hw_session: ")]
+    assert order[:4] == ["bench", "mosaic", "fused", "gens"]
 
 
 def test_done_steps_are_skipped_next_window(sandbox):
     run_queue(sandbox)
     proc = run_queue(sandbox)
     assert proc.returncode == 0
-    assert proc.stdout.count("already done") == 8
+    assert proc.stdout.count("already done") == 9
 
 
 def test_named_step_reruns_despite_marker(sandbox):

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Fused sharded-stepper parity RUN on the real TPU (VERDICT r4 item 1b).
+
+The fused Pallas interiors inside the sharded steppers
+(``parallel/step.py make_sharded_bit_stepper/make_sharded_ltl_stepper``
+with ``use_pallas=True``) are pinned by interpret-mode tests and by the
+virtual-CPU dryrun, but neither exercises Mosaic: the vma-aware
+``pallas_call``-inside-``shard_map`` composition only meets the real
+compiler here.  This tool builds a mesh over the visible chips (1x1 on
+the single-chip tunnel — exactly one chip is all the composition check
+needs), runs a handful of steps through each fused stepper, and asserts
+the result bit-exact against the single-device XLA engines
+(``ops.bitlife.bit_step`` / ``ops.bitltl.ltl_step``) on the same grid —
+the same oracle discipline as the CPU-mesh tests, now with Mosaic
+compiled in (ref hot loop: /root/reference/main.cpp:93-103,36-65).
+
+One JSON line per case; evidence lands in perf/fused_stepper_tpu.json.
+Exit 0 = every case compiled, ran, and matched; 1 = mismatch/failure;
+2 = no TPU reachable.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_tpu.utils.platform import apply_platform_override, probe_platform
+
+# modest shapes: lane-aligned width (4096 cells = 128 words) per kernel
+# contract; small enough that compile dominates and a case stays ~1 min
+ROWS, COLS = 2048, 4096
+STEPS = 8
+
+
+def cases():
+    """(name, run) pairs; run() returns (ok: bool, detail: str)."""
+    import numpy as np
+    import jax
+
+    from mpi_tpu.models.rules import LIFE, rule_from_name
+    from mpi_tpu.ops.bitlife import bit_step, init_packed
+    from mpi_tpu.ops.bitltl import ltl_step
+    from mpi_tpu.parallel.mesh import choose_mesh_shape, make_mesh
+    from mpi_tpu.parallel.step import (
+        make_sharded_bit_stepper, make_sharded_ltl_stepper,
+        sharded_bit_init,
+    )
+
+    n = len(jax.devices())
+    shape = choose_mesh_shape(n)
+    mesh = make_mesh(shape)
+    rows, cols = shape[0] * ROWS, shape[1] * COLS
+    r2 = rule_from_name("R2,B10-13,S8-12")
+
+    def xla_ref(rule, boundary, steps, stepper):
+        g = init_packed(rows, cols, seed=23)
+        for _ in range(steps):
+            g = stepper(g, rule, boundary)
+        return np.asarray(jax.device_get(g))
+
+    def fused(make, rule, boundary, k, steps):
+        evolve = make(
+            mesh, rule, boundary, gens_per_exchange=k, use_pallas=True,
+        )
+        g = sharded_bit_init(mesh, rows, cols, seed=23)
+        out = np.asarray(jax.device_get(evolve(g, steps)))
+        return out
+
+    def check(make, stepper, rule, boundary, k, steps):
+        def run():
+            out = fused(make, rule, boundary, k, steps)
+            ref = xla_ref(rule, boundary, steps, stepper)
+            ok = bool(np.array_equal(out, ref))
+            return ok, "bit-exact" if ok else "MISMATCH vs XLA engine"
+
+        return run
+
+    return mesh, [
+        ("bit-g1-periodic",
+         check(make_sharded_bit_stepper, bit_step, LIFE, "periodic", 1, STEPS)),
+        ("bit-g8-dead",
+         check(make_sharded_bit_stepper, bit_step, LIFE, "dead", 8, STEPS)),
+        ("ltl-r2-g1-dead",
+         check(make_sharded_ltl_stepper, ltl_step, r2, "dead", 1, 2)),
+        ("ltl-r2-g2-periodic",
+         check(make_sharded_ltl_stepper, ltl_step, r2, "periodic", 2, 2)),
+    ]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--json-out", default="perf/fused_stepper_tpu.json",
+                   metavar="PATH", help="evidence file (one JSON array)")
+    args = p.parse_args(argv)
+
+    apply_platform_override()
+    plat = probe_platform()
+    if plat != "tpu":
+        print(json.dumps({"error": f"no TPU (probe={plat})"}))
+        return 2
+
+    import jax
+
+    mesh, case_list = cases()
+    records = []
+    failed = 0
+    for name, run in case_list:
+        t0 = time.perf_counter()
+        try:
+            ok, detail = run()
+        except Exception as e:  # noqa: BLE001 — Mosaic errors vary by version
+            ok, detail = False, f"{type(e).__name__}: {str(e)[:300]}"
+        if not ok:
+            failed += 1
+        rec = {"case": name, "ok": ok, "detail": detail,
+               "elapsed_s": round(time.perf_counter() - t0, 2)}
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    summary = {
+        "platform": jax.devices()[0].platform,
+        "mesh": [mesh.shape[a] for a in mesh.axis_names],
+        "grid_per_shard": [ROWS, COLS],
+        "cases": len(records), "failed": failed,
+        "measured_at_unix": int(time.time()),
+    }
+    print(json.dumps(summary))
+    if args.json_out:
+        from scan_common import write_out  # atomic tmp+replace w/ cleanup
+
+        write_out(args.json_out, {"summary": summary, "cases": records})
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,14 @@
 #!/usr/bin/env bash
-# Round-3 hardware program (ROUND3.md "Queued for the next healthy
-# tunnel window"), one command so a short window is not wasted on
-# orchestration.  Each step is independently resumable; artifacts land
-# under perf/ and logs under perf/hw_session_logs/.
+# Resumable multi-window hardware queue: one command so a short tunnel
+# window is not wasted on orchestration.  Each step is independently
+# resumable across windows (.done markers, round-scoped against
+# VERDICT.md); artifacts land under perf/ and logs under
+# perf/hw_session_logs/.  Steps are ordered cheapest / highest-
+# information first (VERDICT r4 item 2): the observed failure mode is a
+# window dying ~10 min in, so the first minutes must bank a flagship
+# number (bench banks its 8192^2 rung within ~2 min of a healthy
+# probe), then the compile smoke + fused-stepper parity run (seconds to
+# ~2 min), then the ladders.
 #
 # Steps are resumable ACROSS windows: a step that exits 0 drops a
 # .done marker (gitignored) and is skipped on the next full-queue run —
@@ -27,9 +33,9 @@ PROBE_TIMEOUT=${HW_PROBE_TIMEOUT:-170}
 STEP_TIMEOUT=${HW_STEP_TIMEOUT:-1800}
 # bench.py budgets its own probe window + bank + ladder retries + CPU
 # fallback + mesh rungs (computed worst case ~9,900s with every child
-# timing out), so its step gets a larger allowance than the
-# single-measurement tools.
-BENCH_TIMEOUT=${HW_BENCH_TIMEOUT:-10800}
+# timing out, +900s for the 1x1-mesh rung on a single-chip tunnel), so
+# its step gets a larger allowance than the single-measurement tools.
+BENCH_TIMEOUT=${HW_BENCH_TIMEOUT:-11700}
 
 probe() {
   timeout --kill-after=30 "$PROBE_TIMEOUT" python -c "from mpi_tpu.utils.platform import probe_platform; import sys; sys.exit(0 if probe_platform() == 'tpu' else 1)"
@@ -107,35 +113,45 @@ want=${1:-all}
 [ "$want" = all ] || [ "$want" = bench ] && \
   step bench python bench.py
 
-# 2. Throughput roof (16-way parallel chains) + regenerated %roof table.
-[ "$want" = all ] || [ "$want" = roof ] && \
-  step roof python tools/roofline.py --measure-roof
+# 2. Mosaic compile-only smoke of every Pallas kernel variant PLUS the
+#    shard_map-composed fused steppers (seconds per variant; catches
+#    compile regressions across the whole kernel matrix even in a short
+#    window — the single highest-information cheap step, VERDICT r4
+#    items 1a/2).
+[ "$want" = all ] || [ "$want" = mosaic ] && \
+  step mosaic python tools/mosaic_smoke.py
 
-# 3. Engine ladder refresh — the Wallace-tree LtL rewrite moved the
+# 3. Fused sharded-stepper parity RUN on the chip (VERDICT r4 item 1b):
+#    one real Mosaic-compiled execution of the shard_map-composed
+#    use_pallas steppers on a 1x1 mesh, asserted bit-exact vs the XLA
+#    engines; JSON evidence in perf/fused_stepper_tpu.json.
+[ "$want" = all ] || [ "$want" = fused ] && \
+  step fused python tools/fused_stepper_check.py
+
+# 4. LtL temporal-blocking ladder: keep gens>1 in the dispatch only
+#    where a row wins (unblocks the policy wiring, VERDICT r4 item 4).
+[ "$want" = all ] || [ "$want" = gens ] && \
+  step gens python tools/ltl_gens_ladder.py
+
+# 5. Engine ladder refresh — the Wallace-tree LtL rewrite moved the
 #    bit-sliced compute bound ~3.5x; expect bosco rows well above the
 #    old 106 Gcell/s.
 [ "$want" = all ] || [ "$want" = ladder ] && \
   step ladder python tools/engine_ladder.py
 
-# 4. LtL temporal-blocking ladder: keep gens>1 in the dispatch only
-#    where a row wins.
-[ "$want" = all ] || [ "$want" = gens ] && \
-  step gens python tools/ltl_gens_ladder.py
+# 6. Throughput roof (16-way parallel chains) + regenerated %roof table.
+[ "$want" = all ] || [ "$want" = roof ] && \
+  step roof python tools/roofline.py --measure-roof
 
-# 4b. Mosaic compile-only smoke of every Pallas kernel variant (seconds;
-#     catches compile regressions even in a short tunnel window).
-[ "$want" = all ] || [ "$want" = mosaic ] && \
-  step mosaic python tools/mosaic_smoke.py
-
-# 4c. Weak-scaling rung on real hardware: with one visible chip this
-#     banks the 1-device row of the 8->256 ladder (ready to run as-is on
-#     a slice, where it ladders across the visible chips; VERDICT r3
-#     item 5).
+# 7. Weak-scaling rung on real hardware: with one visible chip this
+#    banks the 1-device row of the 8->256 ladder (ready to run as-is on
+#    a slice, where it ladders across the visible chips; VERDICT r3
+#    item 5).
 [ "$want" = all ] || [ "$want" = sweep ] && \
   step sweep python tools/sweep.py --steps 100 --tile 8192 --comm-every 8 \
     --jsonl perf/weakscale_hw.jsonl --out-dir perf --time-file weakscale_hw
 
-# 5. Hardware spot-check of the new Mosaic-compiled paths (overlap +
+# 8. Hardware spot-check of the new Mosaic-compiled paths (overlap +
 #    gens) at product scale via the CLI: radius-2 gens dispatch and a
 #    bosco (r=5, bs_sum kernel) run, timed reports written to perf/.
 if [ "$want" = all ] || [ "$want" = spot ]; then

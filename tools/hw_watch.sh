@@ -5,22 +5,25 @@
 #
 #   nohup setsid bash tools/hw_watch.sh >/dev/null 2>&1 &
 #
-# Probes append to perf/tunnel_probes_r4.log (same evidence trail as
-# rounds 2-3); the session run logs to perf/hw_session_logs/ as usual.
+# Probes append to perf/tunnel_probes_r5.log (same evidence trail as
+# rounds 2-4); the session run logs to perf/hw_session_logs/ as usual.
 # A marker file perf/hw_watch.ran stops duplicate sessions if the
 # watcher is restarted after a successful run.
 set -u
 cd "$(dirname "$0")/.."
 
 INTERVAL=${HW_WATCH_INTERVAL:-900}
-LOG=perf/tunnel_probes_r4.log
+LOG=perf/tunnel_probes_r5.log
 MARK=perf/hw_watch.ran
 mkdir -p perf perf/hw_session_logs
 
 while true; do
   plat=$(timeout --kill-after=30 "${HW_PROBE_TIMEOUT:-170}" python -c "from mpi_tpu.utils.platform import probe_platform; print(probe_platform())" 2>/dev/null | tail -1)
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe=${plat:-error}" >> "$LOG"
-  if [ "${plat:-}" = "tpu" ] && [ ! -e "$MARK" ]; then
+  # MARK is round-scoped the same way the queue's .done markers are: a
+  # marker older than VERDICT.md belongs to a finished previous round
+  # and must not block this round's queue
+  if [ "${plat:-}" = "tpu" ] && { [ ! -e "$MARK" ] || [ VERDICT.md -nt "$MARK" ]; }; then
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel healthy — running hw_session" >> "$LOG"
     # append with a window header: the queue spans multiple windows by
     # design, and a later degrading window must not erase the record of

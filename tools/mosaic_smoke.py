@@ -31,11 +31,16 @@ def variants(quick: bool):
     """(name, build) pairs; build() returns a zero-arg compile thunk."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
 
     from mpi_tpu.models.rules import BOSCO, LIFE, rule_from_name
     from mpi_tpu.ops.pallas_bitlife import pallas_bit_step
     from mpi_tpu.ops.pallas_bitltl import pallas_ltl_step
     from mpi_tpu.ops.pallas_stencil import pallas_step
+    from mpi_tpu.parallel.mesh import AXES, choose_mesh_shape, make_mesh
+    from mpi_tpu.parallel.step import (
+        make_sharded_bit_stepper, make_sharded_ltl_stepper,
+    )
 
     def aval(h, nw):
         return jax.ShapeDtypeStruct((h, nw), jnp.uint32)
@@ -64,6 +69,28 @@ def variants(quick: bool):
 
         return thunk
 
+    # Composed fused steppers (VERDICT r4 item 1a): compiling the bare
+    # kernel is NOT compiling the vma-aware pallas_call-inside-shard_map
+    # composition — these lower the jitted segmented stepper itself on a
+    # mesh over the visible chips (1x1 on the single-chip tunnel; the
+    # real mesh when a slice is visible) at the bench mesh-rung shard
+    # shape (8192x8192 cells/chip, gens=8 — bench.py MESH_TILE_TPU).
+    mesh = make_mesh(choose_mesh_shape(len(jax.devices())))
+    spec = PartitionSpec(*AXES)
+    mi, mj = (mesh.shape[a] for a in AXES)
+
+    def sharded(make, rule, boundary, k, tile_h=8192, tile_nw=256, **kw):
+        def thunk():
+            evolve = make(mesh, rule, boundary, gens_per_exchange=k,
+                          use_pallas=True, **kw)
+            g = jax.ShapeDtypeStruct(
+                (mi * tile_h, mj * tile_nw), jnp.uint32,
+                sharding=NamedSharding(mesh, spec),
+            )
+            evolve.lower(g, k).compile()
+
+        return thunk
+
     r2 = rule_from_name("R2,B10-13,S8-12")
     # bench/production shapes: 8192² rung (NW=256) and the 65536²
     # flagship (NW=2048, the compile-wall regime); sharded local tiles
@@ -71,10 +98,20 @@ def variants(quick: bool):
     out = [
         ("bit-8192-p-g1", bit(8192, 256, "periodic", 1)),
         ("bit-8192-p-g8", bit(8192, 256, "periodic", 8)),
+        ("sharded-bit-8192-p-g8",
+         sharded(make_sharded_bit_stepper, LIFE, "periodic", 8)),
     ]
     if quick:
         return out + [("ltl-r2-16384-d-g1", ltl(16384, 512, r2, "dead", 1))]
     out += [
+        ("sharded-bit-8192-d-g1",
+         sharded(make_sharded_bit_stepper, LIFE, "dead", 1)),
+        ("sharded-bit-8192-d-g1-pad20",
+         sharded(make_sharded_bit_stepper, LIFE, "dead", 1, pad_bits=20)),
+        ("sharded-ltl-r2-8192-d-g1",
+         sharded(make_sharded_ltl_stepper, r2, "dead", 1)),
+        ("sharded-ltl-r2-8192-p-g2",
+         sharded(make_sharded_ltl_stepper, r2, "periodic", 2)),
         ("bit-8192-d-g8", bit(8192, 256, "dead", 8)),
         ("bit-8192-p-g16", bit(8192, 256, "periodic", 16)),
         ("bit-65536-p-g8", bit(65536, 2048, "periodic", 8)),

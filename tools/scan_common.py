@@ -82,9 +82,24 @@ def measure_scan_popcount(one_pass, grid, passes: int, cells_per_call,
 
 
 def write_out(path: str, results) -> None:
+    """Atomic (tmp + os.replace): run_ladder makes the artifact
+    load-bearing resume state, and the queue's KILL (60s after TERM)
+    landing mid-flush must not truncate it — a corrupt artifact would
+    silently drop every banked rung of the round (ADVICE r4).  Mirrors
+    ``bench._atomic_json_dump`` (bench.py stays import-free of tools/ —
+    it is the driver's only perf capture); keep the two in sync."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(results, f, indent=1)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:  # noqa: BLE001 — TERM can land mid-dump
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 # Per-rung retry cap: a rung that fails this many times is recorded as
@@ -151,6 +166,14 @@ def run_ladder(script, rungs, timeout, out_path, identity):
             results.append(row)  # measured, or exhausted: evidence stands
             continue
         attempts = (row or {}).get("_attempts", 0)
+        # pre-flight: the in-flight rung's attempt is persisted BEFORE the
+        # child runs — a step-level TERM/KILL landing mid-child leaves this
+        # provisional row as the record, so a rung that consistently dies
+        # by process kill still exhausts MAX_RUNG_ATTEMPTS across windows
+        # instead of being retried forever (ADVICE r4)
+        prior[key] = {**identity(rung),
+                      "error": "KILLED: attempt did not return",
+                      "_attempts": attempts + 1, "_key": key}
         flush(results, i)  # persist state before the child can hang
         res = run_child(script, rung, timeout)
         res = {**identity(rung), **res, "_key": key}

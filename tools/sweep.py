@@ -164,6 +164,9 @@ def main(argv=None) -> int:
             "cells_per_sec_per_device": round(cps_dev, 1),
             "weak_scaling_efficiency": round(eff, 4),
             "platform": jax.devices()[0].platform,
+            # forced-host-device rows are harness regression guards, not
+            # TPU predictions (CPU memcpy collectives != ICI; PERF.md)
+            "virtual": bool(_VIRTUAL),
         }
         print(json.dumps(record))
         if args.jsonl:

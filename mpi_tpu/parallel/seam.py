@@ -1,0 +1,135 @@
+"""Periodic wrap-seam stitching for padded packed grids (VERDICT r4
+item 5): periodic boundaries on non-word-aligned widths ride the packed
+engines instead of falling to the dense path.
+
+The obstruction: the SWAR/bit-sliced engines shift whole uint32 words,
+so a periodic wrap that lands mid-word (real width C not a multiple of
+32 per shard) cannot be expressed in word arithmetic — rounds 2-4 kept
+such runs on the dense engine (~6-25x slower; the reference's serial
+oracle defines the semantics, ``/root/reference/main_serial.cpp:57``).
+
+The fix reuses the stitched-band idea the overlap path already proves
+out (``parallel/step.py body_overlap``): pad the grid with trailing
+dead columns to word alignment and run the PERIODIC padded stepper as
+the base — its row wrap is exact, and its column wrap reads the
+(re-killed every generation) pad columns, i.e. zeros, so the only wrong
+cells are those whose dependence cone crosses the seam: the ``d = K·r``
+real columns on each side of it.  Those are recomputed exactly by a
+thin dense band — the 4d real columns centered on the seam, extracted
+from the pre-step grid, evolved K generations with true periodic row
+wrap and zero column fill (valid middle 2d by the trapezoid argument) —
+and stitched over the base output by word masking.  The band is
+O(rows · 8·K·r) cells per segment against O(rows · C) for the base: the
+seam costs a sliver of dense compute, not the whole grid.
+
+All band/stitch ops are static-shape global-index slices; under a mesh
+they touch only the word columns at the grid's left and right edges, so
+XLA lowers them to work on the edge shards plus one tiny
+collective-permute pair per segment (the same wrap neighbors the
+ppermute halo already talks to).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mpi_tpu.models.rules import Rule
+from mpi_tpu.ops.bitlife import WORD, unpack
+from mpi_tpu.ops.stencil import apply_rule, counts_from_padded
+from mpi_tpu.utils.segmenting import segmented_evolve
+
+
+def band_cols(C: int, d: int):
+    """The band geometry: input strip = real cols [C-2d, C) ++ [0, 2d)
+    (the 4d real columns centered on the wrap seam, contiguous in
+    periodic space); valid output after k gens = the middle 2d = real
+    cols [C-d, C) ++ [0, d)."""
+    if not 1 <= d <= 31:
+        raise ValueError(f"seam band depth must be in 1..31, got {d}")
+    if C < 4 * d:
+        raise ValueError(
+            f"seam stitching needs width >= {4 * d} (got {C}); tiny "
+            f"grids keep the dense engine"
+        )
+    return 4 * d
+
+
+def extract_band(packed, C: int, d: int):
+    """(rows, 4d) uint8 strip of real cols [C-2d, C) ++ [0, 2d) from the
+    padded packed grid (real cols occupy padded cols [0, C) contiguously
+    — the pad is all trailing)."""
+    band_cols(C, d)
+    lw1 = (2 * d - 1) // WORD
+    left = unpack(packed[:, : lw1 + 1])[:, : 2 * d]
+    rw0, rw1 = (C - 2 * d) // WORD, (C - 1) // WORD
+    roff = (C - 2 * d) - rw0 * WORD
+    right = unpack(packed[:, rw0 : rw1 + 1])[:, roff : roff + 2 * d]
+    return jnp.concatenate([right, left], axis=1)
+
+
+def evolve_band(band, rule: Rule, k: int):
+    """k generations of the dense strip: exact periodic row wrap each
+    generation, zero column fill — column-edge corruption creeps r
+    cells/generation inward, so the middle 2d columns are exact after
+    k gens (trapezoid validity, same argument as the overlap bands)."""
+    r = rule.radius
+    for _ in range(k):
+        x = jnp.concatenate([band[-r:], band, band[:r]], axis=0)
+        x = jnp.pad(x, ((0, 0), (r, r)))
+        counts = counts_from_padded(x, r)
+        band = apply_rule(x[r:-r, r:-r], counts, rule)
+    return band
+
+
+def _blend_cols(packed, dense, g0: int, L: int):
+    """Overwrite global padded cell columns [g0, g0+L) of the packed grid
+    with the (rows, L) uint8 ``dense`` block, by word masking (L <= 31,
+    so at most two word columns are touched; all indices static)."""
+    w0, w1 = g0 // WORD, (g0 + L - 1) // WORD
+    out = packed
+    for w in range(w0, w1 + 1):
+        c0 = max(g0, w * WORD)
+        c1 = min(g0 + L, (w + 1) * WORD)
+        mask = jnp.uint32(0)
+        val = jnp.zeros(packed.shape[0], dtype=jnp.uint32)
+        for c in range(c0, c1):
+            b = jnp.uint32(c - w * WORD)
+            mask = mask | (jnp.uint32(1) << b)
+            val = val | (dense[:, c - g0].astype(jnp.uint32) << b)
+        out = out.at[:, w].set((out[:, w] & ~mask) | val)
+    return out
+
+
+def stitch_band(packed, band, C: int, d: int):
+    """Write the band's valid middle back over the seam: real cols
+    [C-d, C) (strip cols [d, 2d)) and [0, d) (strip cols [2d, 3d))."""
+    packed = _blend_cols(packed, band[:, d : 2 * d], C - d, d)
+    packed = _blend_cols(packed, band[:, 2 * d : 3 * d], 0, d)
+    return packed
+
+
+def make_seam_stepper(inner, rule: Rule, C: int, K: int):
+    """evolve(grid, steps) wrapping a padded PERIODIC packed stepper
+    ``inner`` (built with ``seam_pad`` pad_bits — see
+    ``make_sharded_bit_stepper``): each k-generation segment runs the
+    base step and the dense seam band concurrently (no data dependence
+    between them — the band reads the pre-step grid, so XLA can overlap
+    the tiny dense stencil with the big packed one) and stitches the
+    band's exact seam columns over the base output.
+
+    ``C`` is the REAL width (the padded width is whatever ``inner``'s
+    grids carry); ``K`` the gens-per-exchange the segments honor."""
+    r = rule.radius
+    band_cols(C, K * r)  # validate up front at the deepest segment
+
+    def make_local(k):
+        d = k * r
+
+        def step_k(grid):
+            band = extract_band(grid, C, d)
+            out = inner(grid, k)
+            return stitch_band(out, evolve_band(band, rule, k), C, d)
+
+        return step_k
+
+    return segmented_evolve(make_local, K)

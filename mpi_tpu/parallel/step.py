@@ -230,7 +230,7 @@ def ltl_local_pallas_ok(local_packed_shape, rule: Rule, k: int) -> bool:
 def make_sharded_bit_stepper(
     mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1,
     overlap: bool = False, use_pallas: bool = False,
-    pallas_interpret: bool = False, pad_bits: int = 0,
+    pallas_interpret: bool = False, pad_bits: int = 0, seam_pad: bool = False,
 ):
     """Bitpacked (SWAR) shard-parallel evolution: grids are (rows, cols/32)
     uint32, 32 cells per lane.  The ghost ring is exchanged on packed words
@@ -276,11 +276,19 @@ def make_sharded_bit_stepper(
     ``pad_bits`` > 0 (pad-to-32 routing, VERDICT r3 item 3): the grid was
     padded with that many trailing dead cell columns to reach word
     alignment; they are re-killed after every generation on the last
-    column shard (dead boundary only — periodic wrap cannot cross a
-    misaligned word boundary, so padded periodic runs are not offered).
-    K > 1 forces the exchange-all body (its per-generation loop is where
-    the mask lives); at K = 1 every body — including the fused Pallas
-    interior — is masked once per step, which is every generation.
+    column shard.  K > 1 forces the exchange-all body (its
+    per-generation loop is where the mask lives); at K = 1 every body —
+    including the fused Pallas interior — is masked once per step, which
+    is every generation.
+
+    ``seam_pad`` (VERDICT r4 item 5): permits ``pad_bits`` with the
+    PERIODIC boundary, for use under ``parallel.seam.make_seam_stepper``
+    only.  The column wrap then reads the (always re-killed) pad — i.e.
+    zeros — so cells within K·r real columns of the wrap seam are
+    computed with dead-wrap semantics; the seam wrapper recomputes
+    exactly those columns with a true-periodic dense band and stitches
+    them over this stepper's output.  Standalone padded-periodic use
+    stays rejected: without the wrapper the seam columns are wrong.
     """
     from mpi_tpu.ops.bitlife import bit_next, column_sums
     from mpi_tpu.parallel.halo import exchange_halo_rc
@@ -292,8 +300,11 @@ def make_sharded_bit_stepper(
         raise ValueError(f"gens_per_exchange must be in 1..16, got {K}")
     if K > 1 and 0 in rule.birth:
         raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
-    if pad_bits and boundary == "periodic":
-        raise ValueError("pad_bits requires the dead boundary")
+    if pad_bits and boundary == "periodic" and not seam_pad:
+        raise ValueError(
+            "pad_bits with the periodic boundary is only correct under "
+            "the seam-stitching wrapper (parallel.seam); pass seam_pad=True"
+        )
     spec = PartitionSpec(*axes)
     periodic = boundary == "periodic"
 
@@ -403,7 +414,7 @@ def make_sharded_bit_stepper(
 def make_sharded_ltl_stepper(
     mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1,
     overlap: bool = False, use_pallas: bool = False,
-    pallas_interpret: bool = False, pad_bits: int = 0,
+    pallas_interpret: bool = False, pad_bits: int = 0, seam_pad: bool = False,
 ):
     """Bit-sliced radius-r shard-parallel evolution: packed (rows,
     cols/32) uint32 grids, the LtL generalization of
@@ -443,8 +454,11 @@ def make_sharded_ltl_stepper(
     fallback.  ``pallas_interpret`` for CPU-mesh tests.
 
     ``pad_bits``: trailing dead pad columns re-killed every generation on
-    the last column shard (pad-to-32 routing; dead boundary only; K > 1
-    forces the exchange-all body — see ``make_sharded_bit_stepper``)."""
+    the last column shard (pad-to-32 routing; K > 1 forces the
+    exchange-all body — see ``make_sharded_bit_stepper``).  ``seam_pad``
+    permits pad_bits with the periodic boundary for use under
+    ``parallel.seam.make_seam_stepper`` only (same contract as the
+    radius-1 stepper: the wrapper owns the seam columns)."""
     from mpi_tpu.ops.bitltl import ltl_step
     from mpi_tpu.parallel.halo import exchange_halo_rc
 
@@ -457,8 +471,11 @@ def make_sharded_ltl_stepper(
         )
     if K > 1 and 0 in rule.birth:
         raise ValueError("gens_per_exchange > 1 requires a rule without birth-on-0")
-    if pad_bits and boundary == "periodic":
-        raise ValueError("pad_bits requires the dead boundary")
+    if pad_bits and boundary == "periodic" and not seam_pad:
+        raise ValueError(
+            "pad_bits with the periodic boundary is only correct under "
+            "the seam-stitching wrapper (parallel.seam); pass seam_pad=True"
+        )
     spec = PartitionSpec(*axes)
     periodic = boundary == "periodic"
 

@@ -207,13 +207,18 @@ def test_cli_resume_missing_snapshot_rejected(tmp_path, capsys):
 
 def test_cli_rerun_fewer_writers_prunes_stale_tiles(tmp_path):
     """A rerun of the same name with fewer tile writers must remove the
-    old writers' tiles, or assemble would silently merge two runs."""
+    old writers' tiles, or assemble would silently merge two runs.
+
+    The 32-col periodic grid routes packed-padded since round 5 (seam
+    stitching), so 8-col shards pad to 32 and the fully-pad shards drop
+    out of snapshots: the 2x4 mesh writes pids {0, 4} (each carrying all
+    32 real cols of its row block), the 1x2 rerun writes {0}."""
     run_cli(tmp_path, "rr", "tpu", extra=("--mesh", "2x4"))
     pids = golio.iteration_tile_pids(str(tmp_path), "rr", 16)
-    assert len(pids) == 8
+    assert pids == [0, 4]
     run_cli(tmp_path, "rr", "tpu", extra=("--mesh", "1x2"))
     pids = golio.iteration_tile_pids(str(tmp_path), "rr", 16)
-    assert len(pids) == 2
+    assert pids == [0]
     # and the snapshot still assembles to the oracle grid
     ref = evolve_np(init_tile_np(32, 32, seed=5), 16, LIFE, "periodic")
     np.testing.assert_array_equal(golio.load_snapshot(str(tmp_path), "rr", 16), ref)

@@ -182,9 +182,11 @@ def test_fuzz_pallas_ltl_gens():
 
 def test_fuzz_padded_width_matches_oracle():
     # random NON-word-aligned widths through the product dispatch
-    # (pad-to-32 routing, VERDICT r3 item 3): dead boundary rides the
-    # padded packed engines, periodic the dense engine — both must match
-    # the oracle bit-for-bit whatever path is taken
+    # (pad-to-32 routing, VERDICT r3 item 3; periodic seam stitching,
+    # VERDICT r4 item 5): dead boundary rides the padded packed engines,
+    # periodic the seam-stitched padded engines (dense only when the
+    # band cannot serve) — all must match the oracle bit-for-bit
+    # whatever path is taken
     from mpi_tpu.backends.tpu import run_tpu
     from mpi_tpu.config import GolConfig
 

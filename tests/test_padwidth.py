@@ -1,9 +1,9 @@
 """Pad-to-32 routing (VERDICT r3 item 3): non-word-aligned shard widths
-ride the packed engines on the dead boundary — the grid is padded with
-trailing dead columns to word (or lane) alignment, the steppers re-kill
-the pad every generation, and outputs crop back to the real width.
-Periodic non-aligned widths keep the dense engine (the wrap cannot cross
-a misaligned word boundary).
+ride the packed engines — the grid is padded with trailing dead columns
+to word (or lane) alignment, the steppers re-kill the pad every
+generation, and outputs crop back to the real width.  Periodic
+non-aligned widths pad too since round 5 (seam stitching,
+tests/test_seam.py); only tiny/deep-halo periodic grids keep dense.
 
 Reference semantics being preserved: the dead boundary of the MPI
 program (``/root/reference/main.cpp:243`` — non-periodic Cartesian
@@ -29,10 +29,14 @@ def test_plan_pad_width():
     # aligned widths need no pad
     cfg2 = GolConfig(rows=32, cols=256, steps=1, boundary="dead")
     assert plan_pad_width(cfg2, 1) == (256, 0)
-    # periodic is never padded
+    # periodic pads too (seam stitching, VERDICT r4 item 5)...
     cfg3 = GolConfig(rows=32, cols=100, steps=1, boundary="periodic",
                      mesh_shape=(1, 4))
-    assert plan_pad_width(cfg3, 4) == (100, 0)
+    assert plan_pad_width(cfg3, 4, fused_capable=False) == (128, 28)
+    # ...unless the seam band cannot serve: width < 4*comm_every*r
+    cfg3b = GolConfig(rows=32, cols=36, steps=1, boundary="periodic",
+                      mesh_shape=(1, 1), comm_every=12)
+    assert plan_pad_width(cfg3b, 1) == (36, 0)
     # word-aligned-but-not-lane-aligned widths are left alone (the XLA
     # packed engine serves them directly; only misaligned widths pad)
     cfg4 = GolConfig(rows=32, cols=4000, steps=1, boundary="dead")
@@ -93,18 +97,20 @@ def test_padded_ltl_parity(cols, mesh_shape, K):
     np.testing.assert_array_equal(out, ref)
 
 
-def test_periodic_nonaligned_stays_dense(capsys):
-    # periodic + misaligned width: dense engine, correct, with the note
-    # naming why (select_ltl_mode only notes for radius > 1)
-    cfg = GolConfig(rows=32, cols=100, steps=4, boundary="periodic",
-                    mesh_shape=(1, 4), seed=7)
+def test_periodic_nonaligned_tiny_or_deep_stays_dense(capsys):
+    # only when the seam band cannot serve (width < 4*comm_every*r, or
+    # comm_every*r > 31) does periodic+misaligned keep dense — correct,
+    # with the note naming why (select_ltl_mode only notes for r > 1)
+    cfg = GolConfig(rows=64, cols=36, steps=4, boundary="periodic",
+                    mesh_shape=(1, 1), seed=7, comm_every=12)
     out = run_tpu(cfg)
-    ref = evolve_np(init_tile_np(32, 100, seed=7), 4, LIFE, "periodic")
+    ref = evolve_np(init_tile_np(64, 36, seed=7), 4, LIFE, "periodic")
     np.testing.assert_array_equal(out, ref)
+    # radius-2 with comm_every 8: d=16, width 36 < 64 -> dense + note
     mode, note = select_ltl_mode(
-        GolConfig(rows=32, cols=100, steps=1, boundary="periodic",
-                  mesh_shape=(1, 4), rule=R2), 1, 4)
-    assert mode is None and "periodic wrap" in note
+        GolConfig(rows=64, cols=36, steps=1, boundary="periodic",
+                  mesh_shape=(1, 1), rule=R2, comm_every=8), 1, 1)
+    assert mode is None and "seam stitching needs" in note
 
 
 def test_segment_depths_exact():

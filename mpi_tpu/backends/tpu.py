@@ -110,8 +110,10 @@ def plan_pad_width(config: GolConfig, mj: int, fused_capable=None,
     if shard % WORD == 0:
         return config.cols, 0
     if config.boundary == "periodic":
-        d = config.comm_every * config.rule.radius
-        if d > 31 or config.cols < 4 * d:
+        from mpi_tpu.parallel.seam import seam_serves
+
+        if not seam_serves(config.cols,
+                           config.comm_every * config.rule.radius):
             return config.cols, 0
     cp_shard = -(-shard // WORD) * WORD
     if fused_capable is None:

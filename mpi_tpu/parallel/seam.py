@@ -39,6 +39,14 @@ from mpi_tpu.ops.stencil import apply_rule, counts_from_padded
 from mpi_tpu.utils.segmenting import segmented_evolve
 
 
+def seam_serves(C: int, d: int) -> bool:
+    """THE seam-eligibility predicate — the single source of truth for
+    routing (``plan_pad_width``) and construction (``band_cols``), so
+    the two can never drift: depth d = K·r must fit the word-mask/ghost
+    bound (≤ 31) and the 4d strip must not wrap onto itself (C ≥ 4d)."""
+    return 1 <= d <= 31 and C >= 4 * d
+
+
 def band_cols(C: int, d: int):
     """The band geometry: input strip = real cols [C-2d, C) ++ [0, 2d)
     (the 4d real columns centered on the wrap seam, contiguous in
@@ -46,7 +54,7 @@ def band_cols(C: int, d: int):
     cols [C-d, C) ++ [0, d)."""
     if not 1 <= d <= 31:
         raise ValueError(f"seam band depth must be in 1..31, got {d}")
-    if C < 4 * d:
+    if not seam_serves(C, d):
         raise ValueError(
             f"seam stitching needs width >= {4 * d} (got {C}); tiny "
             f"grids keep the dense engine"

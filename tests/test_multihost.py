@@ -129,7 +129,8 @@ def test_two_process_multihost_packed_engine(tmp_path):
 
 
 @pytest.mark.parametrize("rows,cols,name", [
-    (32, 32, "ckpt"),    # dense engine (shard width not word-aligned)
+    (32, 32, "ckpt"),    # misaligned width: seam-stitched packed engine
+                         # since round 5 (_put_initial zero-fills the pad)
     (64, 256, "pck"),    # bitpacked engine (_put_initial packs regions)
 ])
 def test_two_process_multihost_resume(tmp_path, rows, cols, name):

@@ -18,25 +18,34 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SIDE = 16384
-# (name, cell budget per timed call) — budget / SIDE^2 = steps.  The two
-# Pallas SWAR rows share the same 8e12 budget so their headline g1-vs-g8
-# comparison carries identical (sub-2%) dispatch overhead; the slower
-# engines get smaller budgets (their calls already run many seconds).
+# (name, cell budget per timed call, side) — budget / side^2 = steps.
+# The Pallas SWAR rows share the same 8e12 budget so their headline
+# g1-vs-g8 comparison carries identical (sub-2%) dispatch overhead; the
+# slower engines get smaller budgets (their calls already run many
+# seconds).
 ENGINES = (
-    ("dense-xla", 4e11),
-    ("dense-pallas", 8e11),
-    ("swar-xla", 2e12),
-    ("swar-pallas-g1", 8e12),
-    ("swar-pallas-g8", 8e12),
+    ("dense-xla", 4e11, SIDE),
+    ("dense-pallas", 8e11, SIDE),
+    ("swar-xla", 2e12, SIDE),
+    ("swar-pallas-g1", 8e12, SIDE),
+    ("swar-pallas-g8", 8e12, SIDE),
+    # per-size g1/g8 pairs (VERDICT r4 item 7): whether gens=8 stays the
+    # winner at the bench rung sizes, where width penalty and compile
+    # cost differ — the measured winner feeds SINGLE_DEVICE_PALLAS_GENS
+    # (one global constant today; a size-keyed table if these disagree)
+    ("swar-pallas-g1", 8e12, 8192),
+    ("swar-pallas-g8", 8e12, 8192),
+    ("swar-pallas-g1", 8e12, 65536),
+    ("swar-pallas-g8", 8e12, 65536),
     # radius-5 (Bosco) rows: the dense engines vs the bit-sliced engine,
     # XLA path included to pin its HBM-bound collapse at this size
-    ("bosco-dense-pallas", 2e11),
-    ("bosco-bitsliced-xla", 2e11),
-    ("bosco-bitsliced-pallas", 8e11),
+    ("bosco-dense-pallas", 2e11, SIDE),
+    ("bosco-bitsliced-xla", 2e11, SIDE),
+    ("bosco-bitsliced-pallas", 8e11, SIDE),
 )
 
 
-def child(name: str, budget: float) -> None:
+def child(name: str, budget: float, side: int) -> None:
     import jax
 
     from mpi_tpu.utils.platform import apply_platform_override
@@ -57,7 +66,7 @@ def child(name: str, budget: float) -> None:
         raise RuntimeError("engine ladder needs the real chip")
 
     gens = 8 if name.endswith("g8") else 1
-    steps = steps_for_budget(budget, SIDE * SIDE, gens)
+    steps = steps_for_budget(budget, side * side, gens)
     packed = name.startswith("swar") or "bitsliced" in name
 
     if name == "dense-xla":
@@ -75,13 +84,13 @@ def child(name: str, budget: float) -> None:
     else:
         one = lambda g: pallas_bit_step(g, LIFE, "periodic", gens=gens)  # noqa: E731
 
-    grid = (init_packed(SIDE, SIDE, seed=1) if packed
-            else init_tile_jnp(SIDE, SIDE, seed=1))
+    grid = (init_packed(side, side, seed=1) if packed
+            else init_tile_jnp(side, side, seed=1))
     compile_s, best = measure_scan_popcount(
-        one, grid, steps // gens, SIDE * SIDE * steps, packed=packed
+        one, grid, steps // gens, side * side * steps, packed=packed
     )
     print(json.dumps({
-        "engine": name, "side": SIDE, "steps": steps,
+        "engine": name, "side": side, "steps": steps,
         "gcells_per_s": round(best / 1e9, 1),
         "compile_s": round(compile_s, 1),
     }))
@@ -100,12 +109,12 @@ def main(argv=None) -> int:
 
     results, unresolved = run_ladder(
         __file__, ENGINES, args.timeout, args.out,
-        lambda rung: {"engine": rung[0]})
+        lambda rung: {"engine": rung[0], "side": rung[2]})
     return ladder_exit("engine_ladder", results, unresolved)
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child(sys.argv[2], float(sys.argv[3]))
+        child(sys.argv[2], float(sys.argv[3]), int(sys.argv[4]))
         sys.exit(0)
     sys.exit(main())

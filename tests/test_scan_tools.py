@@ -154,7 +154,7 @@ def test_mosaic_smoke_variants_supported():
     assert ltl_local_pallas_ok((8192, 256), r2, 1)
     assert ltl_local_pallas_ok((8192, 256), r2, 2)
     assert {"sharded-bit-8192-p-g8", "sharded-bit-8192-d-g1-pad20",
-            "sharded-ltl-r2-8192-d-g1",
+            "sharded-bit-8192-p-g1-seam20", "sharded-ltl-r2-8192-d-g1",
             "sharded-ltl-r2-8192-p-g2"} <= set(names)
     # gated: no TPU here -> rc 2 and a JSON error line, nothing raised
     assert ms.main([]) == 2

@@ -78,6 +78,26 @@ def cases():
 
         return run
 
+    def seam_run():
+        # the round-5 seam path end-to-end through run_tpu on the chip:
+        # misaligned periodic width, padded packed base + dense wrap
+        # band + word-mask stitch, vs the independent numpy oracle
+        from mpi_tpu.backends.serial_np import evolve_np
+        from mpi_tpu.backends.tpu import run_tpu
+        from mpi_tpu.config import GolConfig
+        from mpi_tpu.utils.hashinit import init_tile_np
+
+        # per-shard 4085 cols: misaligned, lane-stretches to 4096 at
+        # K=1 so the fused interior engages under the seam wrapper
+        rows_s, cols_s, steps_s = shape[0] * 1024, shape[1] * 4085, 4
+        cfg = GolConfig(rows=rows_s, cols=cols_s, steps=steps_s,
+                        boundary="periodic", mesh_shape=shape, seed=29)
+        out = run_tpu(cfg, mesh=mesh)
+        ref = evolve_np(init_tile_np(rows_s, cols_s, seed=29), steps_s,
+                        LIFE, "periodic")
+        ok = bool(np.array_equal(out, ref))
+        return ok, "bit-exact" if ok else "MISMATCH vs serial oracle"
+
     return mesh, [
         ("bit-g1-periodic",
          check(make_sharded_bit_stepper, bit_step, LIFE, "periodic", 1, STEPS)),
@@ -87,6 +107,7 @@ def cases():
          check(make_sharded_ltl_stepper, ltl_step, r2, "dead", 1, 2)),
         ("ltl-r2-g2-periodic",
          check(make_sharded_ltl_stepper, ltl_step, r2, "periodic", 2, 2)),
+        ("seam-bit-misaligned-periodic", seam_run),
     ]
 
 

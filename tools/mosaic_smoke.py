@@ -79,10 +79,16 @@ def variants(quick: bool):
     spec = PartitionSpec(*AXES)
     mi, mj = (mesh.shape[a] for a in AXES)
 
-    def sharded(make, rule, boundary, k, tile_h=8192, tile_nw=256, **kw):
+    def sharded(make, rule, boundary, k, tile_h=8192, tile_nw=256,
+                seam=False, **kw):
         def thunk():
             evolve = make(mesh, rule, boundary, gens_per_exchange=k,
                           use_pallas=True, **kw)
+            if seam:
+                from mpi_tpu.parallel.seam import make_seam_stepper
+
+                real_c = mj * tile_nw * 32 - kw["pad_bits"]
+                evolve = make_seam_stepper(evolve, rule, real_c, k)
             g = jax.ShapeDtypeStruct(
                 (mi * tile_h, mj * tile_nw), jnp.uint32,
                 sharding=NamedSharding(mesh, spec),
@@ -108,6 +114,12 @@ def variants(quick: bool):
          sharded(make_sharded_bit_stepper, LIFE, "dead", 1)),
         ("sharded-bit-8192-d-g1-pad20",
          sharded(make_sharded_bit_stepper, LIFE, "dead", 1, pad_bits=20)),
+        # the seam-wrapped composition (round 5): padded PERIODIC base +
+        # dense wrap band + word-mask stitch, the full program a
+        # misaligned periodic run compiles
+        ("sharded-bit-8192-p-g1-seam20",
+         sharded(make_sharded_bit_stepper, LIFE, "periodic", 1,
+                 pad_bits=20, seam_pad=True, seam=True)),
         ("sharded-ltl-r2-8192-d-g1",
          sharded(make_sharded_ltl_stepper, r2, "dead", 1)),
         ("sharded-ltl-r2-8192-p-g2",

@@ -180,6 +180,45 @@ def test_seam_pad_guard():
                              seam_pad=True)
 
 
+def test_seam_minimum_width_exact():
+    # near-minimum width: K=16 radius-1 deep halo, d=16, width 66 vs
+    # the 4d=64 floor — the strip covers nearly the whole grid.  (The
+    # exact C==64 run is unreachable here — 64 is word-aligned — so the
+    # C==4d boundary itself is pinned on the predicate.)
+    cfg = GolConfig(rows=64, cols=66, steps=17, boundary="periodic",
+                    mesh_shape=(1, 1), seed=25, comm_every=16)
+    out = run_tpu(cfg)
+    ref = evolve_np(init_tile_np(64, 66, seed=25), 17, LIFE, "periodic")
+    np.testing.assert_array_equal(out, ref)
+    from mpi_tpu.parallel.seam import seam_serves
+
+    assert seam_serves(64, 16)          # C == 4d, the exact floor
+    assert not seam_serves(63, 16)
+    assert seam_serves(66, 16) and not seam_serves(1000, 32)
+
+
+def test_seam_snapshots_crop_to_real_width(tmp_path):
+    # snapshot tiles of a seam run must stitch back to the REAL grid at
+    # every snapshot boundary (crop + wrapper interplay)
+    from mpi_tpu import golio
+
+    cfg = GolConfig(rows=32, cols=100, steps=4, boundary="periodic",
+                    mesh_shape=(1, 4), seed=27, snapshot_every=2)
+
+    def cb(iteration, tiles):
+        for pid, tile, r0, c0 in tiles:
+            golio.write_tile_fmt(str(tmp_path), "seam", iteration, pid,
+                                 tile, r0, c0)
+
+    run_tpu(cfg, snapshot_cb=cb)
+    golio.write_master(str(tmp_path), "seam", 32, 100, 2, 4, 4)
+    for it in (0, 2, 4):
+        got = golio.assemble(str(tmp_path), "seam", it)
+        ref = evolve_np(init_tile_np(32, 100, seed=27), it, LIFE,
+                        "periodic")
+        np.testing.assert_array_equal(got, ref, err_msg=f"iteration {it}")
+
+
 def test_seam_resume_roundtrip():
     # straight-through == run-to-half + resume, periodic padded width
     full = run_tpu(GolConfig(rows=32, cols=100, steps=8,

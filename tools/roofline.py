@@ -214,6 +214,9 @@ def main() -> int:
         pct = 100.0 * tput * ops / args.roof
         rows.append({
             "engine": name,
+            # the ladder carries per-size rungs for the same engine
+            # (VERDICT r4 item 7) — keep the side so rows stay distinct
+            "side": entry.get("side"),
             "gcells_per_s": entry["gcells_per_s"],
             "ops_per_cell": round(ops, 2),
             "ops_basis": basis,
@@ -228,11 +231,12 @@ def main() -> int:
         json.dump(payload, f, indent=1)
 
     print(f"roof = {args.roof:.3g} lane-ops/s (measured chain, lower bound)")
-    print("| engine | Gcell/s | ops/cell | % of roof | |")
-    print("|---|---|---|---|---|")
+    print("| engine | side | Gcell/s | ops/cell | % of roof | |")
+    print("|---|---|---|---|---|---|")
     for r in rows:
         flag = "headroom" if r["headroom_flag"] else ""
-        print(f"| {r['engine']} | {r['gcells_per_s']:.0f} | "
+        print(f"| {r['engine']} | {r.get('side') or ''} | "
+              f"{r['gcells_per_s']:.0f} | "
               f"{r['ops_per_cell']} | {r['pct_of_roof']:.0f}% | {flag} |")
     print(f"\nwrote {args.out}")
     return 0

@@ -193,6 +193,44 @@ def test_fused_stepper_check_gated_and_well_formed(tmp_path):
     assert ltl_local_pallas_ok((fc.ROWS, nw), r2, 2)
 
 
+def test_fused_stepper_check_interpret_sandbox(monkeypatch, capsys):
+    # execute the WHOLE tool (all five cases, real script logic) with
+    # the kernels in interpret mode on the virtual mesh: a bug in the
+    # parity runner must surface here, not burn a tunnel window
+    import importlib.util
+    import json as _json
+
+    monkeypatch.setenv("MPI_TPU_FUSED_CHECK_INTERPRET", "1")
+    monkeypatch.setenv("MPI_TPU_FUSED_CHECK_ROWS", "64")
+    # the tool's seam case sets this via bare os.environ — register it
+    # with monkeypatch so teardown restores it for later tests
+    monkeypatch.setenv("MPI_TPU_PALLAS_INTERPRET", "1")
+    spec = importlib.util.spec_from_file_location(
+        "fused_stepper_check_interp",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "fused_stepper_check.py"))
+    fc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fc)
+
+    # the shrunken sandbox shape must still engage the fused dispatch,
+    # or the sandbox would silently exercise only the XLA fallback
+    from mpi_tpu.models.rules import LIFE, rule_from_name
+    from mpi_tpu.parallel.step import bit_local_pallas_ok, ltl_local_pallas_ok
+
+    assert fc.ROWS == 64
+    r2 = rule_from_name("R2,B10-13,S8-12")
+    assert bit_local_pallas_ok((64, fc.COLS // 32), LIFE, 8)
+    assert ltl_local_pallas_ok((64, fc.COLS // 32), r2, 2)
+
+    assert fc.main([]) == 0
+    lines = [_json.loads(ln)
+             for ln in capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["failed"] == 0 and summary["interpret"] is True
+    assert summary["cases"] == 5
+    assert all(rec["ok"] for rec in lines[:-1])
+
+
 def _ladder(monkeypatch, tmp_path, child_results,
             rungs=(("a", 1), ("b", 2))):
     """Run run_ladder with run_child stubbed to answer from the

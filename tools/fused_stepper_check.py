@@ -17,6 +17,12 @@ compiled in (ref hot loop: /root/reference/main.cpp:93-103,36-65).
 One JSON line per case; evidence lands in perf/fused_stepper_tpu.json.
 Exit 0 = every case compiled, ran, and matched; 1 = mismatch/failure;
 2 = no TPU reachable.
+
+Sandbox mode (CI): ``MPI_TPU_FUSED_CHECK_INTERPRET=1`` runs every case
+with the kernels in interpret mode on whatever platform is available
+(``MPI_TPU_FUSED_CHECK_ROWS`` shrinks the shapes), executing the tool's
+full logic end-to-end — a bug here must surface in CI, not burn a
+tunnel window.
 """
 
 import argparse
@@ -30,8 +36,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from mpi_tpu.utils.platform import apply_platform_override, probe_platform
 
 # modest shapes: lane-aligned width (4096 cells = 128 words) per kernel
-# contract; small enough that compile dominates and a case stays ~1 min
-ROWS, COLS = 2048, 4096
+# contract; small enough that compile dominates and a case stays ~1 min.
+# The ROWS shrink knob is honored in the interpret sandbox ONLY — a
+# stale export in a hardware shell must not silently shrink a parity
+# run that then ships as chip evidence.
+INTERP = os.environ.get("MPI_TPU_FUSED_CHECK_INTERPRET") == "1"
+ROWS = int(os.environ.get("MPI_TPU_FUSED_CHECK_ROWS", "2048")) if INTERP \
+    else 2048
+COLS = 4096
 STEPS = 8
 
 
@@ -64,6 +76,7 @@ def cases():
     def fused(make, rule, boundary, k, steps):
         evolve = make(
             mesh, rule, boundary, gens_per_exchange=k, use_pallas=True,
+            pallas_interpret=INTERP,
         )
         g = sharded_bit_init(mesh, rows, cols, seed=23)
         out = np.asarray(jax.device_get(evolve(g, steps)))
@@ -87,9 +100,13 @@ def cases():
         from mpi_tpu.config import GolConfig
         from mpi_tpu.utils.hashinit import init_tile_np
 
+        if INTERP:
+            # run_tpu's dispatch honors the interpret env knob off-TPU
+            os.environ["MPI_TPU_PALLAS_INTERPRET"] = "1"
         # per-shard 4085 cols: misaligned, lane-stretches to 4096 at
         # K=1 so the fused interior engages under the seam wrapper
-        rows_s, cols_s, steps_s = shape[0] * 1024, shape[1] * 4085, 4
+        rows_s = shape[0] * min(1024, ROWS)
+        cols_s, steps_s = shape[1] * 4085, 4
         cfg = GolConfig(rows=rows_s, cols=cols_s, steps=steps_s,
                         boundary="periodic", mesh_shape=shape, seed=29)
         out = run_tpu(cfg, mesh=mesh)
@@ -113,13 +130,17 @@ def cases():
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--json-out", default="perf/fused_stepper_tpu.json",
-                   metavar="PATH", help="evidence file (one JSON array)")
+    p.add_argument("--json-out", default=None, metavar="PATH",
+                   help="evidence file (default perf/fused_stepper_tpu.json"
+                   " on hardware; no file in interpret sandbox mode, so a"
+                   " CI run can never shadow chip evidence)")
     args = p.parse_args(argv)
+    if args.json_out is None and not INTERP:
+        args.json_out = "perf/fused_stepper_tpu.json"
 
     apply_platform_override()
     plat = probe_platform()
-    if plat != "tpu":
+    if plat != "tpu" and not INTERP:
         print(json.dumps({"error": f"no TPU (probe={plat})"}))
         return 2
 
@@ -142,6 +163,7 @@ def main(argv=None) -> int:
         print(json.dumps(rec), flush=True)
     summary = {
         "platform": jax.devices()[0].platform,
+        "interpret": INTERP,
         "mesh": [mesh.shape[a] for a in mesh.axis_names],
         "grid_per_shard": [ROWS, COLS],
         "cases": len(records), "failed": failed,

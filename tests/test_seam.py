@@ -219,6 +219,35 @@ def test_seam_snapshots_crop_to_real_width(tmp_path):
         np.testing.assert_array_equal(got, ref, err_msg=f"iteration {it}")
 
 
+def test_seam_snapshots_with_deep_halo(tmp_path):
+    # comm_every=3 + snapshot_every=3 over 8 steps: segments [3, 3, 2]
+    # genuinely mix stepper depths {3, 2} under the seam wrapper — every
+    # snapshot boundary must still crop to the real width and match the
+    # oracle
+    from mpi_tpu import golio
+    from mpi_tpu.config import plan_segments
+    from mpi_tpu.utils.segmenting import segment_depths
+
+    assert plan_segments(8, 3) == [3, 3, 2]
+    assert segment_depths([3, 3, 2], 3) == {3, 2}
+    cfg = GolConfig(rows=32, cols=100, steps=8, boundary="periodic",
+                    mesh_shape=(1, 2), seed=31, comm_every=3,
+                    snapshot_every=3)
+
+    def cb(iteration, tiles):
+        for pid, tile, r0, c0 in tiles:
+            golio.write_tile_fmt(str(tmp_path), "sd", iteration, pid,
+                                 tile, r0, c0)
+
+    run_tpu(cfg, snapshot_cb=cb)
+    golio.write_master(str(tmp_path), "sd", 32, 100, 3, 8, 2)
+    for it in (0, 3, 6, 8):
+        got = golio.assemble(str(tmp_path), "sd", it)
+        ref = evolve_np(init_tile_np(32, 100, seed=31), it, LIFE,
+                        "periodic")
+        np.testing.assert_array_equal(got, ref, err_msg=f"iteration {it}")
+
+
 def test_seam_resume_roundtrip():
     # straight-through == run-to-half + resume, periodic padded width
     full = run_tpu(GolConfig(rows=32, cols=100, steps=8,

@@ -793,9 +793,52 @@ def _attach_verified(out, prior=_LOAD_FROM_DISK) -> None:
         )
 
 
+def serve_bench() -> None:
+    """`python bench.py --serve`: the EngineCache micro-benchmark.
+
+    Creates the same board shape twice through the serve layer and
+    reports the setup time the cache saved — the number the whole
+    subsystem exists to make large.  Separate invocation mode (like
+    --probe): the default `python bench.py` JSON schema that the driver
+    parses is untouched.  Emits exactly one JSON line either way; errors
+    land in the "error" field, never on stdout as a traceback.
+    """
+    out = {"bench": "serve", "ok": False}
+    try:
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.session import SessionManager
+
+        spec = {"rows": 256, "cols": 256, "backend": "tpu",
+                "comm_every": 2, "segments": [2, 10]}
+        mgr = SessionManager(EngineCache(max_size=4))
+        t0 = time.perf_counter()
+        first = mgr.create(dict(spec))
+        t1 = time.perf_counter()
+        second = mgr.create(dict(spec, seed=1))
+        t2 = time.perf_counter()
+        assert not first["cache_hit"], "first create must be a cache miss"
+        assert second["cache_hit"], "second create must be a cache hit"
+        assert second["engine_compiles"] == first["engine_compiles"], \
+            "cache hit must add zero XLA compiles"
+        out.update(
+            ok=True,
+            cache_hit=second["cache_hit"],
+            engine_compiles=first["engine_compiles"],
+            first_create_s=round(t1 - t0, 4),
+            second_create_s=round(t2 - t1, 4),
+            setup_saved_s=round((t1 - t0) - (t2 - t1), 4),
+            cache=mgr.cache.stats(),
+        )
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        serve_bench()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":

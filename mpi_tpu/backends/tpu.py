@@ -12,6 +12,7 @@ the timing reports stay comparable.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
@@ -218,15 +219,22 @@ def select_ltl_mode(config: GolConfig, mi: int, mj: int, cols=None,
     if r <= 1:
         return None, None
     if (cols // mj) % 32 != 0:
-        # plan_pad_width declined to pad: periodic seam stitching needs
-        # comm_every·r <= 31 and width >= 4·comm_every·r (tiny grids are
-        # exactly where dense is fine)
-        return None, (
+        # plan_pad_width declined to pad.  The note names the config's
+        # actual boundary (ADVICE r5): only periodic runs have a seam
+        # gate to explain — on a dead boundary a misaligned width landing
+        # here must not claim "periodic … seam stitching" (tiny grids are
+        # exactly where dense is fine either way)
+        note = (
             f"radius-{r} rule on non-word-aligned shard width "
-            f"({config.cols}/{mj} cols per shard), periodic: dense "
-            f"engine (seam stitching needs comm_every*radius <= 31 and "
-            f"width >= {4 * config.comm_every * r})"
+            f"({config.cols}/{mj} cols per shard), {config.boundary}: "
+            f"dense engine"
         )
+        if config.boundary == "periodic":
+            note += (
+                f" (seam stitching needs comm_every*radius <= 31 and "
+                f"width >= {4 * config.comm_every * r})"
+            )
+        return None, note
     if mi * mj == 1 and not pad_bits and _ltl_single_device(config):
         return "pallas", None
     if config.comm_every * r > 31:
@@ -355,25 +363,193 @@ def _put_initial(mesh, initial, rows: int, cols: int, packed: bool,
     return jax.make_array_from_single_device_arrays(gshape, sharding, arrays)
 
 
-def run_tpu(
-    config: GolConfig,
-    timer: Optional[PhaseTimer] = None,
-    snapshot_cb: Optional[SnapshotCb] = None,
-    mesh=None,
-    initial=None,
-    start_iteration: int = 0,
-):
-    """Run one configuration; returns the final grid as a host numpy array
-    (or None under multi-host execution, where no single host can fetch
-    the global array — the snapshot tiles are the multi-host output).
+class Engine:
+    """A compiled stepper bound to one plan signature.
 
-    initial/start_iteration support checkpoint-restart: pass a grid loaded
-    by ``golio.load_snapshot`` (or, multihost, a region loader backed by
-    ``golio.assemble_region``) and the iteration it was saved at.
-    """
-    timer = timer or PhaseTimer()
+    Everything ``run_tpu`` used to set up inline — pad-to-32 planning,
+    engine dispatch, seam wrapping, compile fallback — factored into an
+    object that outlives one run: ``mpi_tpu.serve`` keeps Engines in an
+    LRU cache (``serve/cache.py``) so a second board with the same plan
+    signature reuses the compiled executables instead of paying the
+    XLA/Mosaic compile again.  ``run_tpu`` is a thin one-shot wrapper.
+
+    Grid state lives OUTSIDE the engine — every method takes/returns it —
+    so any number of sessions can share one engine.  Segment executables
+    compile lazily per distinct length and memoize in ``_compiled``;
+    ``compile_count`` counts real XLA compiles (the serve layer's
+    zero-recompile-on-cache-hit assertion reads it)."""
+
+    def __init__(self, config: GolConfig, mesh, evolve, *, bitpacked: bool,
+                 cols_eff: int, pad_bits: int, used_pallas: bool,
+                 fallback_factory, notes=()):
+        from mpi_tpu.parallel.mesh import AXES
+
+        self.config = config
+        self.mesh = mesh
+        self.mi, self.mj = mesh.shape[AXES[0]], mesh.shape[AXES[1]]
+        self.bitpacked = bitpacked
+        self.cols_eff = cols_eff
+        self.pad_bits = pad_bits
+        self.notes = tuple(notes)
+        self._evolve = evolve
+        self._used_pallas = used_pallas
+        self._fallback_factory = fallback_factory
+        self._compiled = {}
+        self._compile_lock = threading.Lock()
+        self.compile_count = 0
+        self._unpacker = None
+
+    @property
+    def col_limit(self):
+        """Real grid width of a padded run (None when nothing is padded)."""
+        return self.config.cols if self.pad_bits else None
+
+    def init_grid(self, initial=None, seed=None):
+        """A fresh device-resident grid on this engine's mesh/sharding.
+        ``seed`` overrides config.seed: serve sessions share one engine
+        across seeds (the seed is deliberately not in the plan key)."""
+        seed = self.config.seed if seed is None else seed
+        if self.bitpacked:
+            from mpi_tpu.parallel.step import sharded_bit_init
+
+            if initial is not None:
+                return _put_initial(self.mesh, initial, self.config.rows,
+                                    self.cols_eff, True,
+                                    col_limit=self.col_limit)
+            return sharded_bit_init(self.mesh, self.config.rows,
+                                    self.cols_eff, seed,
+                                    col_limit=self.col_limit)
+        if initial is not None:
+            return _put_initial(self.mesh, initial, self.config.rows,
+                                self.config.cols, False)
+        return sharded_init(self.mesh, self.config.rows, self.config.cols,
+                            seed)
+
+    def ensure_compiled(self, grid, n: int):
+        """The compiled executable advancing ``grid`` by ``n`` generations
+        (lazily lowered + compiled, memoized).  A fused Pallas kernel that
+        fails to COMPILE (Mosaic register allocation, a VMEM shape outside
+        the calibrated map) degrades to the always-available shard_map/XLA
+        stepper instead of killing the run; if the dispatch never chose a
+        Pallas kernel the error is real — re-raise rather than pay a
+        second identical compile under a misleading note."""
+        c = self._compiled.get(n)
+        if c is not None:
+            return c
+        with self._compile_lock:
+            return self._compile_locked(grid, n)
+
+    def _compile_locked(self, grid, n: int):
+        # serve sessions share one engine across HTTP handler threads; a
+        # race here would double-compile AND double-count (the cache's
+        # zero-recompile assertion reads compile_count)
+        c = self._compiled.get(n)
+        if c is not None:
+            return c
+        try:
+            c = self._evolve.lower(grid, n).compile()
+        except Exception as e:  # noqa: BLE001 — Mosaic/VMEM errors vary by version
+            if not self._used_pallas:
+                raise
+            import sys
+
+            print(
+                f"note: fused kernel failed to compile "
+                f"({type(e).__name__}: {str(e)[:200]}); falling back to the "
+                f"XLA stepper",
+                file=sys.stderr,
+            )
+            self._evolve = self._fallback_factory()
+            self._used_pallas = False
+            # drop Pallas-built executables so every depth reruns through
+            # the one fallback stepper (outputs are bit-identical either
+            # way — the parity suite proves it — but one program is easier
+            # to reason about than a mixed table)
+            self._compiled.clear()
+            c = self._evolve.lower(grid, n).compile()
+        self._compiled[n] = c
+        self.compile_count += 1
+        return c
+
+    def compile_segments(self, grid, segments) -> None:
+        """Ahead-of-time compile every distinct segment length (compilation
+        is "setup"; steady-state stepping is what throughput is measured
+        on — same accounting as the reference's topology+alloc phase)."""
+        for n in sorted(set(segments)):
+            if n > 0:
+                self.ensure_compiled(grid, n)
+
+    def step(self, grid, n: int):
+        """Advance ``grid`` by ``n`` generations (compiling on first use of
+        a new segment length).  The input buffer is donated — callers must
+        replace their reference with the returned grid."""
+        if n <= 0:
+            return grid
+        return self.ensure_compiled(grid, n)(grid)
+
+    def _get_unpacker(self):
+        if self._unpacker is None and self.bitpacked:
+            from mpi_tpu.parallel.step import make_sharded_unpacker
+
+            self._unpacker = make_sharded_unpacker(self.mesh)
+        return self._unpacker
+
+    def tiles(self, grid):
+        """Snapshot tiles ``(pid, tile, r0, c0)`` for every addressable
+        shard (the np.asarray fetches inside are the real barrier)."""
+        up = self._get_unpacker()
+        return _shard_tiles(up(grid) if up is not None else grid,
+                            col_limit=self.col_limit)
+
+    def fetch(self, grid):
+        """Final grid as a host numpy array, cropped to the real width
+        (None under multi-host execution, where no single host can fetch
+        the global array — snapshot tiles are the multi-host output)."""
+        if jax.process_count() > 1:
+            return None
+        final = np.asarray(jax.device_get(grid))
+        if self.bitpacked:
+            from mpi_tpu.ops.bitlife import unpack_np
+
+            out = unpack_np(final)
+            return out[:, : self.config.cols] if self.pad_bits else out
+        return final
+
+    def population(self, grid) -> int:
+        """Live-cell count without fetching the whole grid (a rows-long
+        vector crosses the host tunnel, not rows x cols cells).  Exact on
+        padded runs too: the steppers re-kill the dead pad every
+        generation, so packed popcounts never see pad bits."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self.bitpacked:
+            per_row = jnp.sum(
+                lax.population_count(grid).astype(jnp.uint32), axis=1)
+        else:
+            per_row = jnp.sum(grid.astype(jnp.uint32), axis=1)
+        return int(np.asarray(jax.device_get(per_row), dtype=np.int64).sum())
+
+
+def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:
+    """Resolve the full plan for ``config`` — mesh, pad-to-32 width,
+    engine dispatch, seam wrapping, overlap feasibility — and return an
+    :class:`Engine` holding the (uncompiled) stepper.
+
+    This is the stable seam the serve layer's EngineCache memoizes behind
+    ``mpi_tpu.config.plan_signature``; ``run_tpu`` calls it once per
+    invocation, the serve layer once per cache miss.  Planning notes print
+    to stderr as they are decided (same wording/ordering as before the
+    refactor) and are also retained on ``Engine.notes`` for /stats.
+
+    ``depths``: the local-step depths that will actually be traced
+    (``run_tpu`` passes the exact segment plan via ``segment_depths``);
+    None uses the conservative 1..comm_every superset — right for
+    persistent engines, which step by arbitrary k."""
+    import sys
+
     mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
-    from mpi_tpu.config import validate_mesh
+    from mpi_tpu.config import ConfigError, validate_mesh
     from mpi_tpu.parallel.mesh import AXES
 
     # Auto-chosen meshes must pass the same compatibility checks as
@@ -384,24 +560,25 @@ def run_tpu(
         config.rule.radius * config.comm_every,
     )
 
+    notes = []
+
+    def _note(msg: str) -> None:
+        notes.append(msg)
+        print(f"note: {msg}", file=sys.stderr)
+
     # Engine choice: bitpacked SWAR (32 cells/lane) for radius-1 rules when
     # every shard's width packs into whole uint32 words; dense uint8 else.
     # Non-word-aligned dead-boundary widths are padded to alignment and
     # still take the packed engines (pad-to-32 routing, VERDICT r3 item
     # 3): the steppers re-kill the dead pad every generation and the
     # outputs crop back to the real width.
-    from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
+    from mpi_tpu.ops.bitlife import WORD
 
     cols_eff, pad_bits = plan_pad_width(config, mj,
                                         shard_rows=config.rows // mi)
     packed_mode = config.rule.radius == 1 and (cols_eff // mj) % WORD == 0
-    # the segment plan (and so the set of stepper depths that will be
-    # traced) is known up front — the Pallas compile-fallback gate is
-    # computed from the depths that actually run
-    want_snapshots = snapshot_cb is not None and config.snapshot_every > 0
-    segments = plan_segments(
-        config.steps, config.snapshot_every if want_snapshots else 0)
-    seg_depths = segment_depths(segments, config.comm_every)
+    if depths is None:
+        depths = range(1, config.comm_every + 1)  # conservative superset
     # radius > 1: the packed bit-sliced LtL engine replaces the dense path
     # when it applies (same packed init/snapshot plumbing) — the fused
     # Pallas kernel on one device, the shard_map/ppermute XLA stepper on
@@ -410,20 +587,19 @@ def run_tpu(
         else select_ltl_mode(config, mi, mj, cols=cols_eff, pad_bits=pad_bits)
     if not packed_mode and not ltl_mode:
         cols_eff, pad_bits = config.cols, 0  # dense path: no padding
-        if config.rule.radius == 1 and (config.cols // mj) % WORD != 0:
+        if (config.rule.radius == 1 and config.boundary == "periodic"
+                and (config.cols // mj) % WORD != 0):
             # radius-1 misaligned landing on dense means the periodic
-            # seam gate declined (dead always pads) — same note
-            # discipline as the radius>1 fallbacks: a run on the ~6-25x
-            # slower engine must say why (most misaligned widths ride
-            # the packed engines since round 5)
-            import sys
-
-            print(
-                f"note: non-word-aligned periodic width {config.cols}"
+            # seam gate declined (gated on the boundary itself, ADVICE
+            # r5 — dead boundaries always pad, so only periodic can land
+            # here) — same note discipline as the radius>1 fallbacks: a
+            # run on the ~6-25x slower engine must say why (most
+            # misaligned widths ride the packed engines since round 5)
+            _note(
+                f"non-word-aligned periodic width {config.cols}"
                 f"/{mj} cols per shard: dense engine (seam stitching "
                 f"needs comm_every*radius <= 31 and width >= "
-                f"{4 * config.comm_every * config.rule.radius})",
-                file=sys.stderr,
+                f"{4 * config.comm_every * config.rule.radius})"
             )
     # periodic + pad: the packed stepper runs with dead-wrap seam
     # semantics and the seam wrapper recomputes/stitches the wrap
@@ -441,22 +617,17 @@ def run_tpu(
             ev, config.rule, config.cols, config.comm_every
         )
     if ltl_note is not None:
-        import sys
-
-        print(f"note: {ltl_note}", file=sys.stderr)
+        _note(ltl_note)
     if config.overlap and pad_bits and config.comm_every > 1 \
             and (packed_mode or ltl_mode == "sharded"):
         # padded widths at K > 1 run the exchange-all body (the pad must
         # be re-killed between generations) — say so instead of silently
         # dropping the requested overlap
-        import sys
-
-        print(
-            "note: --overlap dropped: padded (non-word-aligned) width "
+        _note(
+            "--overlap dropped: padded (non-word-aligned) width "
             "with comm_every > 1 uses the exchange-all packed body "
             "(still far faster than the dense engine; overlap needs "
-            "comm_every 1 here)",
-            file=sys.stderr,
+            "comm_every 1 here)"
         )
     overlap_eff = config.overlap
     if config.overlap and mi * mj > 1 \
@@ -471,18 +642,13 @@ def run_tpu(
         # a hard error on a config that ran in round 4 (dense engine)
         # would be a regression — the packed run without overlap is
         # still far faster than the dense run with it.
-        from mpi_tpu.config import ConfigError
-
         def _overlap_too_small(need_msg):
             nonlocal overlap_eff
             if pad_bits:
-                import sys
-
-                print(
-                    f"note: --overlap dropped: padded tile too small for "
+                _note(
+                    f"--overlap dropped: padded tile too small for "
                     f"the stitched bands ({need_msg}); running the packed "
-                    f"engine without overlap",
-                    file=sys.stderr,
+                    f"engine without overlap"
                 )
                 overlap_eff = False
             else:
@@ -512,10 +678,6 @@ def run_tpu(
                     f"bands (got {tile_r}x{tile_c})"
                 )
     if packed_mode or ltl_mode:
-        from mpi_tpu.parallel.step import (
-            sharded_bit_init, make_sharded_unpacker,
-        )
-
         if ltl_mode == "pallas":
             from mpi_tpu.ops.pallas_bitltl import make_pallas_ltl_stepper
 
@@ -538,64 +700,29 @@ def run_tpu(
                 seam_pad=seam,
             )
             shard = _shard_shape_packed(config, mesh, cols_eff)
-            depths = ([k for k in seg_depths if k == 1] if pad_bits
-                      else seg_depths)
+            dep = ([k for k in depths if k == 1] if pad_bits else depths)
             used_pallas = use and any(
-                ltl_local_pallas_ok(shard, config.rule, k) for k in depths
+                ltl_local_pallas_ok(shard, config.rule, k) for k in dep
             )
         else:
             evolve, used_pallas = _pick_packed_evolve(
                 config, mesh, mi * mj, cols=cols_eff, pad_bits=pad_bits,
-                depths=seg_depths, seam_pad=seam, overlap=overlap_eff,
-            )
-        evolve = _wrap_seam(evolve)
-        if initial is not None:
-            grid = _put_initial(mesh, initial, config.rows, cols_eff, True,
-                                col_limit=config.cols if pad_bits else None)
-        else:
-            grid = sharded_bit_init(
-                mesh, config.rows, cols_eff, config.seed,
-                col_limit=config.cols if pad_bits else None,
+                depths=depths, seam_pad=seam, overlap=overlap_eff,
             )
     else:
         evolve, used_pallas = _pick_dense_evolve(config, mesh, mi * mj)
-        if initial is not None:
-            grid = _put_initial(mesh, initial, config.rows, config.cols, False)
-        else:
-            grid = sharded_init(mesh, config.rows, config.cols, config.seed)
+    evolve = _wrap_seam(evolve)
 
-    # Compile every distinct segment length ahead of time: compilation is
-    # "setup", steady-state stepping is what throughput is measured on.
-    # (want_snapshots/segments were computed before engine selection —
-    # the fallback gate needs the traced depths.)
-    def compile_segments(ev):
-        return {n: ev.lower(grid, n).compile() for n in sorted(set(segments))}
-
-    try:
-        compiled = compile_segments(evolve)
-    except Exception as e:  # noqa: BLE001 — Mosaic/VMEM errors vary by version
-        # A fused Pallas kernel that fails to COMPILE (Mosaic register
-        # allocation, a VMEM shape outside the calibrated map) must
-        # degrade to the always-available shard_map/XLA stepper instead
-        # of killing a production run.  If the dispatch never chose a
-        # Pallas kernel, the error is real — re-raise rather than pay a
-        # second identical compile under a misleading note.
-        if not used_pallas:
-            raise
-        import sys
-
-        print(
-            f"note: fused kernel failed to compile "
-            f"({type(e).__name__}: {str(e)[:200]}); falling back to the "
-            f"XLA stepper",
-            file=sys.stderr,
-        )
+    def fallback_factory():
+        # the always-available shard_map/XLA stepper, for a fused Pallas
+        # kernel that fails to compile (same arguments as the main path —
+        # the one _wrap_seam helper keeps them from drifting)
         from mpi_tpu.parallel.step import (
             make_sharded_bit_stepper, make_sharded_ltl_stepper,
         )
 
         if packed_mode:
-            evolve = make_sharded_bit_stepper(
+            ev = make_sharded_bit_stepper(
                 mesh, config.rule, config.boundary,
                 gens_per_exchange=config.comm_every, overlap=overlap_eff,
                 pad_bits=pad_bits, seam_pad=seam,
@@ -603,18 +730,57 @@ def run_tpu(
         elif ltl_mode:
             # comm_every·r ≤ max_gens(r)·r ≤ 8·1 | 4·2 | 2·4 ≤ 8 word
             # halo bits — always within the sharded stepper's 31-bit bound
-            evolve = make_sharded_ltl_stepper(
+            ev = make_sharded_ltl_stepper(
                 mesh, config.rule, config.boundary,
                 gens_per_exchange=config.comm_every, overlap=overlap_eff,
                 pad_bits=pad_bits, seam_pad=seam,
             )
         else:
-            evolve = make_sharded_stepper(
+            ev = make_sharded_stepper(
                 mesh, config.rule, config.boundary,
                 gens_per_exchange=config.comm_every, overlap=config.overlap,
             )
-        evolve = _wrap_seam(evolve)
-        compiled = compile_segments(evolve)
+        return _wrap_seam(ev)
+
+    return Engine(
+        config, mesh, evolve, bitpacked=packed_mode or bool(ltl_mode),
+        cols_eff=cols_eff, pad_bits=pad_bits, used_pallas=used_pallas,
+        fallback_factory=fallback_factory, notes=notes,
+    )
+
+
+def run_tpu(
+    config: GolConfig,
+    timer: Optional[PhaseTimer] = None,
+    snapshot_cb: Optional[SnapshotCb] = None,
+    mesh=None,
+    initial=None,
+    start_iteration: int = 0,
+):
+    """Run one configuration; returns the final grid as a host numpy array
+    (or None under multi-host execution, where no single host can fetch
+    the global array — the snapshot tiles are the multi-host output).
+
+    initial/start_iteration support checkpoint-restart: pass a grid loaded
+    by ``golio.load_snapshot`` (or, multihost, a region loader backed by
+    ``golio.assemble_region``) and the iteration it was saved at.
+
+    One-shot wrapper over :func:`build_engine`: plan + compile is "setup"
+    (the reference's topology+alloc phase), the segment loop is the timed
+    steady state — identical CLI contract, snapshot files, and stderr
+    notes as before the engine refactor.
+    """
+    timer = timer or PhaseTimer()
+    # the segment plan (and so the set of stepper depths that will be
+    # traced) is known up front — the Pallas compile-fallback gate is
+    # computed from the depths that actually run
+    want_snapshots = snapshot_cb is not None and config.snapshot_every > 0
+    segments = plan_segments(
+        config.steps, config.snapshot_every if want_snapshots else 0)
+    engine = build_engine(
+        config, mesh=mesh, depths=segment_depths(segments, config.comm_every))
+    grid = engine.init_grid(initial=initial)
+    engine.compile_segments(grid, segments)
 
     from mpi_tpu.utils.platform import force_fetch
 
@@ -624,36 +790,19 @@ def run_tpu(
     force_fetch(grid)
     timer.setup_done()
 
-    unpacker = (make_sharded_unpacker(mesh)
-                if (packed_mode or ltl_mode) and want_snapshots else None)
-
-    def tiles_of(g):
-        return _shard_tiles(
-            unpacker(g) if unpacker is not None else g,
-            col_limit=config.cols if pad_bits else None,
-        )
-
     it = start_iteration
     if want_snapshots and it == 0:
-        snapshot_cb(0, tiles_of(grid))
+        snapshot_cb(0, engine.tiles(grid))
     for n in segments:
-        grid = compiled[n](grid)
+        grid = engine.step(grid, n)
         it += n
         if want_snapshots:
-            # tiles_of's np.asarray(shard.data) fetches are the real
-            # barrier here; no block_until_ready needed (or trusted)
-            snapshot_cb(it, tiles_of(grid))
+            # tiles' np.asarray(shard.data) fetches are the real barrier
+            # here; no block_until_ready needed (or trusted)
+            snapshot_cb(it, engine.tiles(grid))
     force_fetch(grid)
     timer.finish()
-    if jax.process_count() > 1:
-        # the global array spans non-addressable devices; hosts keep their
-        # shards (snapshots already wrote them) — no host-side global grid
-        return None
-    final = np.asarray(jax.device_get(grid))
-    if packed_mode or ltl_mode:
-        out = unpack_np(final)
-        return out[:, : config.cols] if pad_bits else out
-    return final
+    return engine.fetch(grid)
 
 
 def device_count() -> int:

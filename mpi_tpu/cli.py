@@ -130,6 +130,13 @@ def _log(quiet: bool, msg: str) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # subcommand dispatch ahead of the positional-args parser (which
+        # would read "serve" as rows); the one-shot contract is untouched
+        from mpi_tpu.serve.cli import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         return _run(args)

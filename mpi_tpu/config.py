@@ -144,6 +144,27 @@ class GolConfig:
         return self.rows * self.cols
 
 
+def plan_signature(config: GolConfig, mesh_shape: Tuple[int, int],
+                   segments=()) -> tuple:
+    """Hashable key of everything compilation depends on — the EngineCache
+    key (``mpi_tpu.serve``).  Two configs with equal signatures can share
+    one compiled :class:`~mpi_tpu.backends.tpu.Engine`.
+
+    Deliberately EXCLUDES ``steps``, ``snapshot_every``, ``seed``,
+    ``out_dir`` and ``workers``: none of them reach the stepper's traced
+    program (seed only picks the initial grid; the step plan only picks
+    which segment lengths get compiled, and those are carried separately
+    as the sorted distinct ``segments`` set).  ``mesh_shape`` is the
+    RESOLVED shape (auto-chosen meshes must not alias an explicit one of
+    a different shape), and ``Rule`` is a frozen dataclass of frozensets,
+    so the whole tuple hashes."""
+    return (
+        config.rows, config.cols, config.rule, config.boundary,
+        config.backend, tuple(mesh_shape), config.comm_every,
+        bool(config.overlap), tuple(sorted(set(segments))),
+    )
+
+
 def plan_segments(steps: int, snapshot_every: int) -> List[int]:
     """Split `steps` into evolution-segment lengths between snapshot points
     (shared by every backend so their snapshot series always align)."""

@@ -31,13 +31,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from mpi_tpu.parallel.mesh import AXES
+from mpi_tpu.parallel.mesh import AXES, axis_size
 
 
 def _axis_exchange(x, axis_name: str, spatial_axis: int, radius: int, periodic: bool):
     """Extend x by radius ghost slices on both ends of spatial_axis, filled
     from the previous/next shard along mesh axis axis_name."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     size = x.shape[spatial_axis]
     first = lax.slice_in_dim(x, 0, radius, axis=spatial_axis)
     last = lax.slice_in_dim(x, size - radius, size, axis=spatial_axis)

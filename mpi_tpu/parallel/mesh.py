@@ -20,6 +20,21 @@ AXIS_COLS = "gj"   # mesh axis sharding grid cols
 AXES: Tuple[str, str] = (AXIS_ROWS, AXIS_COLS)
 
 
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis, from inside ``shard_map``.
+
+    ``jax.lax.axis_size`` only exists in newer JAX; on older versions the
+    classic ``psum(1, axis)`` idiom constant-folds to a Python int (the
+    callers use the result in ``range()``/``if``, so it must be static
+    either way)."""
+    import jax.lax as lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def choose_mesh_shape(n_devices: int) -> Tuple[int, int]:
     """Most-square 2D factorization of n (the ``MPI_Dims_create`` analog).
 

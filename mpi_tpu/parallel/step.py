@@ -18,14 +18,26 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 try:  # jax.shard_map is the public name on recent JAX
-    shard_map = jax.shard_map
+    _shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_rep" in __import__("inspect").signature(_shard_map).parameters:
+    # JAX 0.4.x's replication checker has no rule for pallas_call, so it
+    # rejects the fused tile interiors outright; every stepper here pins
+    # explicit out_specs, so the checker buys nothing and is disabled.
+    # (psum(1, axis) — the axis_size shim — still constant-folds to a
+    # Python int with the checker off; verified on 0.4.37.)
+    def shard_map(f, **kwargs):
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(f, **kwargs)
+else:  # pragma: no cover — newer JAX dropped the flag
+    shard_map = _shard_map
 
 from mpi_tpu.models.rules import Rule
 from mpi_tpu.ops.stencil import counts_from_padded, apply_rule
 from mpi_tpu.parallel.halo import exchange_halo
-from mpi_tpu.parallel.mesh import AXES
+from mpi_tpu.parallel.mesh import AXES, axis_size
 from mpi_tpu.utils.hashinit import init_tile_jnp
 from mpi_tpu.utils.segmenting import segmented_evolve
 
@@ -45,8 +57,8 @@ def _kill_outside_global(x, axes, margins):
     ci = lax.broadcasted_iota(jnp.int32, x.shape, 1)
     i0 = lax.axis_index(axes[0])
     j0 = lax.axis_index(axes[1])
-    ni = lax.axis_size(axes[0])
-    nj = lax.axis_size(axes[1])
+    ni = axis_size(axes[0])
+    nj = axis_size(axes[1])
     if top:
         x = jnp.where((i0 == 0) & (ri < top), zero, x)
     if bottom:
@@ -182,7 +194,7 @@ def _mask_pad_cols(x, axes, ghost_words: int, tile_words: int, pad_bits: int):
     if pad_bits <= 0:
         return x
     j = lax.axis_index(axes[1])
-    nj = lax.axis_size(axes[1])
+    nj = axis_size(axes[1])
     col_limit = nj * tile_words * WORD_BITS - pad_bits  # real global cols
     nw = x.shape[1]
     w_iota = jnp.arange(nw, dtype=jnp.int32) - ghost_words

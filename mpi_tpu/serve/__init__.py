@@ -1,0 +1,17 @@
+"""``mpi_tpu.serve`` — persistent multi-session engine service.
+
+The batch engine (``run_tpu``) pays plan + XLA/Mosaic compile on every
+invocation and drives exactly one board.  This package keeps the process
+alive instead: an :class:`EngineCache` memoizes compiled steppers by plan
+signature (``mpi_tpu.config.plan_signature``), a :class:`SessionManager`
+owns N independent boards with device-resident state between requests,
+and a stdlib-only HTTP front end (``httpd``) exposes the session verbs —
+the serving layer the ROADMAP's north star needs on top of the batch
+engine.  ``mpi_tpu serve`` (``serve/cli.py``) wires it together.
+"""
+
+from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.serve.httpd import make_server
+
+__all__ = ["EngineCache", "SessionManager", "make_server"]

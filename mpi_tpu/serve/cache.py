@@ -1,0 +1,78 @@
+"""LRU cache of compiled engines, keyed by plan signature.
+
+Compilation is the expensive part of a board's life (the reference's
+whole setup phase); two boards whose plans agree on everything the traced
+program depends on (``mpi_tpu.config.plan_signature``) can share one
+:class:`~mpi_tpu.backends.tpu.Engine` and its compiled segment table.
+The cache makes "create a second board of the same shape" cost zero new
+XLA compiles — the acceptance criterion ``tests/test_serve.py`` asserts
+via the counters here plus ``Engine.compile_count``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Tuple
+
+
+class EngineCache:
+    """Size-bounded LRU of ``signature -> engine`` with hit/miss/eviction
+    counters (surfaced on ``/stats``).
+
+    ``get_or_build`` runs the factory INSIDE the lock: concurrent create
+    requests for the same signature must not both pay the compile — the
+    second waits and hits.  Builds for different signatures serialize
+    too; acceptable for a cache whose values each take seconds of XLA
+    time to build (a per-signature lock table would only help the case
+    where two *different* expensive plans arrive in the same instant).
+    """
+
+    def __init__(self, max_size: int = 8):
+        if max_size < 1:
+            raise ValueError(f"max_size must be >= 1, got {max_size}")
+        self.max_size = max_size
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+
+    def get_or_build(self, signature: tuple,
+                     factory: Callable[[], object]) -> Tuple[object, bool]:
+        """(engine, hit).  On miss the factory's engine is inserted and the
+        least-recently-used entry beyond ``max_size`` is dropped (its
+        compiled executables are freed when the last session using it
+        lets go — sessions hold their own reference, so eviction never
+        yanks an engine out from under a live board)."""
+        with self._lock:
+            eng = self._entries.get(signature)
+            if eng is not None:
+                self._entries.move_to_end(signature)
+                self.hits += 1
+                return eng, True
+            self.misses += 1
+            eng = factory()
+            self._entries[signature] = eng
+            while len(self._entries) > self.max_size:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return eng, False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, signature: tuple) -> bool:
+        with self._lock:
+            return signature in self._entries
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": len(self._entries),
+                "max_size": self.max_size,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

@@ -1,0 +1,63 @@
+"""``mpi_tpu serve`` — run the session service.
+
+Example::
+
+    python -m mpi_tpu.cli serve --port 8000 --cache-size 8
+    curl -X POST localhost:8000/sessions -d '{"rows":64,"cols":64,"backend":"serial"}'
+    curl -X POST localhost:8000/sessions/s1/step -d '{"steps":10}'
+    curl localhost:8000/sessions/s1/density
+    curl localhost:8000/stats
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_tpu serve",
+        description="persistent multi-session engine service "
+        "(HTTP + JSON, compiled-stepper cache)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 binds an ephemeral port (printed on startup)")
+    p.add_argument("--cache-size", type=int, default=8,
+                   help="max cached compiled engines (LRU beyond this)")
+    p.add_argument("--verbose", action="store_true",
+                   help="log one line per HTTP request")
+    return p
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from mpi_tpu.config import ConfigError
+    from mpi_tpu.serve.cache import EngineCache
+    from mpi_tpu.serve.httpd import make_server
+    from mpi_tpu.serve.session import SessionManager
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    try:
+        manager = SessionManager(EngineCache(max_size=args.cache_size))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    server = make_server(args.host, args.port, manager, verbose=args.verbose)
+    host, port = server.server_address[:2]
+    print(f"[mpi_tpu] serving on http://{host}:{port} "
+          f"(cache size {args.cache_size})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("[mpi_tpu] shutting down", flush=True)
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

@@ -1,0 +1,126 @@
+"""Stdlib-only HTTP front end (``http.server`` + JSON, no new deps).
+
+Routes (all bodies and responses are JSON):
+
+    POST   /sessions                   create a board (spec in body)
+    POST   /sessions/<id>/step         advance; body {"steps": k}, default 1
+    GET    /sessions/<id>/snapshot     full grid as '0'/'1' row strings
+    GET    /sessions/<id>/density      live-cell count / density
+    DELETE /sessions/<id>              close the board
+    GET    /healthz                    liveness probe
+    GET    /stats                      cache counters + per-session throughput
+
+Errors: 400 with {"error": ...} for bad specs/bodies (``ConfigError``/
+``ValueError``), 404 for unknown sessions and routes.  The server is a
+``ThreadingHTTPServer`` — requests against different boards run
+concurrently; the per-session locks in ``session.py`` serialize requests
+against the same board.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from mpi_tpu.config import ConfigError
+from mpi_tpu.serve.session import SessionManager
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the manager is attached to the *server* by make_server; handlers are
+    # constructed per request
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n == 0:
+            return {}
+        try:
+            data = json.loads(self.rfile.read(n) or b"{}")
+        except json.JSONDecodeError as e:
+            raise ConfigError(f"request body is not valid JSON: {e}")
+        if not isinstance(data, dict):
+            raise ConfigError("request body must be a JSON object")
+        return data
+
+    def _route(self) -> Tuple[str, Optional[str], Optional[str]]:
+        """(kind, session_id, verb) from the path."""
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if parts == ["healthz"]:
+            return "healthz", None, None
+        if parts == ["stats"]:
+            return "stats", None, None
+        if parts and parts[0] == "sessions":
+            if len(parts) == 1:
+                return "sessions", None, None
+            if len(parts) == 2:
+                return "session", parts[1], None
+            if len(parts) == 3:
+                return "session", parts[1], parts[2]
+        return "unknown", None, None
+
+    def _dispatch(self, method: str) -> None:
+        mgr: SessionManager = self.server.manager
+        kind, sid, verb = self._route()
+        try:
+            if kind == "healthz" and method == "GET":
+                return self._reply(200, {"ok": True, "sessions": len(mgr)})
+            if kind == "stats" and method == "GET":
+                return self._reply(200, mgr.stats())
+            if kind == "sessions" and method == "POST":
+                return self._reply(200, mgr.create(self._body()))
+            if kind == "session" and sid is not None:
+                if method == "POST" and verb == "step":
+                    steps = self._body().get("steps", 1)
+                    if not isinstance(steps, int):
+                        raise ConfigError(f"steps must be an int, got {steps!r}")
+                    return self._reply(200, mgr.step(sid, steps))
+                if method == "GET" and verb == "snapshot":
+                    return self._reply(200, mgr.snapshot(sid))
+                if method == "GET" and verb == "density":
+                    return self._reply(200, mgr.density(sid))
+                if method == "DELETE" and verb is None:
+                    return self._reply(200, mgr.close(sid))
+            return self._reply(404, {"error": f"no route {method} {self.path}"})
+        except KeyError:
+            return self._reply(404, {"error": f"no session {sid!r}"})
+        except (ConfigError, ValueError) as e:
+            return self._reply(400, {"error": str(e)})
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+
+def make_server(host: str = "127.0.0.1", port: int = 0,
+                manager: Optional[SessionManager] = None,
+                verbose: bool = False) -> ThreadingHTTPServer:
+    """A ready-to-run server (not yet serving — call ``serve_forever`` or
+    drive it from a thread; ``port=0`` binds an ephemeral port, which the
+    tests use).  The bound address is ``server.server_address``."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.manager = manager if manager is not None else SessionManager()
+    server.verbose = verbose
+    return server

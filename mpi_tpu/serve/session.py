@@ -1,0 +1,307 @@
+"""Board sessions: device-resident state between requests.
+
+A session is one live board — created once (paying setup: plan + compile
+on a cache miss, nearly nothing on a hit), then stepped/inspected by any
+number of requests.  The backend dispatch mirrors ``cli.py``'s: the same
+four backends, the same engine semantics, so a board served over HTTP is
+bit-identical to the same config run one-shot (the parity tests in
+``tests/test_serve.py`` hold the serve path to the ``serial_np`` oracle
+exactly like the batch CLI's parity suite).
+
+Sessions and engines are decoupled: TPU sessions hold a *reference* to a
+cached :class:`~mpi_tpu.backends.tpu.Engine` plus their own grid buffer,
+so N boards of the same shape share one compiled stepper.  Eviction from
+the :class:`~mpi_tpu.serve.cache.EngineCache` only drops the cache's
+reference — live sessions keep theirs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from mpi_tpu.config import ConfigError, GolConfig, plan_signature
+from mpi_tpu.models.rules import rule_from_name
+from mpi_tpu.serve.cache import EngineCache
+
+_SPEC_KEYS = {
+    "rows", "cols", "rule", "boundary", "backend", "seed", "comm_every",
+    "overlap", "mesh", "segments",
+}
+
+
+def _parse_spec(spec: dict):
+    """(GolConfig, segments) from a create-request JSON body.  Strict on
+    key names — a typoed knob silently falling back to its default is the
+    worst failure mode a service API can have."""
+    if not isinstance(spec, dict):
+        raise ConfigError(f"session spec must be a JSON object, got {type(spec).__name__}")
+    unknown = set(spec) - _SPEC_KEYS
+    if unknown:
+        raise ConfigError(
+            f"unknown session keys {sorted(unknown)}; allowed: {sorted(_SPEC_KEYS)}"
+        )
+    try:
+        rows = int(spec["rows"])
+        cols = int(spec["cols"])
+    except KeyError as e:
+        raise ConfigError(f"session spec needs {e.args[0]!r}")
+    mesh = spec.get("mesh")
+    if isinstance(mesh, str):
+        try:
+            a, b = mesh.lower().split("x")
+            mesh = (int(a), int(b))
+        except ValueError:
+            raise ConfigError(f"mesh must look like 2x4, got {mesh!r}")
+    elif mesh is not None:
+        try:
+            a, b = mesh
+            mesh = (int(a), int(b))
+        except (TypeError, ValueError):
+            raise ConfigError(f"mesh must be 'IxJ' or [i, j], got {mesh!r}")
+    segments = spec.get("segments", [1])
+    try:
+        segments = sorted({int(n) for n in segments if int(n) > 0})
+    except (TypeError, ValueError):
+        raise ConfigError(f"segments must be a list of ints, got {spec.get('segments')!r}")
+    config = GolConfig(
+        rows=rows,
+        cols=cols,
+        steps=0,                       # sessions step on demand, not by plan
+        seed=int(spec.get("seed", 0)),
+        rule=rule_from_name(str(spec.get("rule", "life"))),
+        boundary=str(spec.get("boundary", "periodic")),
+        backend=str(spec.get("backend", "tpu")),
+        mesh_shape=mesh,
+        comm_every=int(spec.get("comm_every", 1)),
+        overlap=bool(spec.get("overlap", False)),
+    )
+    return config, segments
+
+
+class Session:
+    """One live board.  ``engine`` is set for tpu sessions (grid is a
+    device array); host backends keep a numpy grid and a ``stepper(grid,
+    n) -> grid`` closure instead.  All mutation goes through ``lock`` —
+    the HTTP server is threaded and two requests against one board must
+    serialize (two requests against two boards must not)."""
+
+    def __init__(self, sid: str, config: GolConfig, *, engine=None,
+                 stepper=None, grid=None, cache_hit: bool = False,
+                 setup_s: float = 0.0):
+        self.id = sid
+        self.config = config
+        self.engine = engine
+        self.stepper = stepper
+        self.grid = grid
+        self.cache_hit = cache_hit
+        self.generation = 0
+        self.setup_s = setup_s          # plan + compile (grows if a step
+        self.steady_s = 0.0             # needs a new depth); stepping time
+        self.lock = threading.Lock()
+        self.closed = False
+
+    def throughput(self) -> dict:
+        gens = self.generation
+        cells = self.config.cells
+        return {
+            "generations": gens,
+            "steady_s": round(self.steady_s, 6),
+            "setup_s": round(self.setup_s, 6),
+            "gens_per_s": (gens / self.steady_s) if self.steady_s > 0 else None,
+            "cell_updates_per_s": (gens * cells / self.steady_s)
+            if self.steady_s > 0 else None,
+        }
+
+
+class SessionManager:
+    """Owns the session table and the engine cache.
+
+    Single-host by design (multi-host serving is a ROADMAP open item):
+    snapshot/density fetch through ``Engine.fetch``/``population``, which
+    assume one process can address the whole array.
+    """
+
+    def __init__(self, cache: Optional[EngineCache] = None):
+        self.cache = cache if cache is not None else EngineCache()
+        self._sessions: Dict[str, Session] = {}
+        self._lock = threading.Lock()
+        self._next = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create(self, spec: dict) -> dict:
+        config, segments = _parse_spec(spec)
+        t0 = time.perf_counter()
+        if config.backend == "tpu":
+            session = self._create_tpu(config, segments)
+        else:
+            session = self._create_host(config)
+        session.setup_s = time.perf_counter() - t0
+        with self._lock:
+            self._next += 1
+            session.id = f"s{self._next}"
+            self._sessions[session.id] = session
+        info = self.describe(session)
+        info["cache"] = self.cache.stats()
+        return info
+
+    def _create_tpu(self, config: GolConfig, segments) -> Session:
+        from mpi_tpu.backends.tpu import build_engine, device_count
+        from mpi_tpu.parallel.mesh import choose_mesh_shape, make_mesh
+
+        mesh_shape = config.mesh_shape or choose_mesh_shape(device_count())
+        sig = plan_signature(config, mesh_shape, segments)
+        engine, hit = self.cache.get_or_build(
+            sig, lambda: build_engine(config, mesh=make_mesh(mesh_shape)))
+        grid = engine.init_grid(seed=config.seed)
+        # precompile the requested segment set (a no-op on a cache hit —
+        # the signature pins the set, so the hit engine already has it)
+        engine.compile_segments(grid, segments)
+        return Session("?", config, engine=engine, grid=grid, cache_hit=hit)
+
+    def _create_host(self, config: GolConfig) -> Session:
+        from mpi_tpu.utils.hashinit import init_tile_np
+
+        rule, boundary = config.rule, config.boundary
+        if config.backend == "serial":
+            from mpi_tpu.backends.serial_np import evolve_np
+
+            def stepper(g, n):
+                return evolve_np(g, n, rule, boundary)
+        elif config.backend == "cpp":
+            from mpi_tpu.backends.cpp import evolve_cpp, load_library
+
+            load_library()              # build/dlopen is setup, like compile
+
+            def stepper(g, n):
+                return evolve_cpp(g, n, rule, boundary)
+        else:  # cpp-par
+            from mpi_tpu.backends.cpp import (
+                evolve_par_cpp, load_library, plan_tiles,
+            )
+
+            load_library()
+            tiles = plan_tiles((config.rows, config.cols), config.workers,
+                               rule.radius)
+
+            def stepper(g, n):
+                return evolve_par_cpp(g, n, rule, boundary, tiles=tiles)
+
+        grid = init_tile_np(config.rows, config.cols, config.seed)
+        return Session("?", config, stepper=stepper, grid=grid)
+
+    def close(self, sid: str) -> dict:
+        with self._lock:
+            session = self._sessions.pop(sid, None)
+        if session is None:
+            raise KeyError(sid)
+        with session.lock:
+            session.closed = True
+            session.grid = None         # free device/host buffers now; the
+            session.engine = None       # cached engine survives for reuse
+        return {"id": sid, "closed": True}
+
+    def get(self, sid: str) -> Session:
+        with self._lock:
+            session = self._sessions.get(sid)
+        if session is None:
+            raise KeyError(sid)
+        return session
+
+    # -- verbs -------------------------------------------------------------
+
+    def step(self, sid: str, steps: int = 1) -> dict:
+        if steps < 1:
+            raise ConfigError(f"steps must be >= 1, got {steps}")
+        session = self.get(sid)
+        with session.lock:
+            if session.closed:
+                raise KeyError(sid)
+            if session.engine is not None:
+                import jax
+
+                # a depth never seen before compiles here — that is setup,
+                # not stepping; charge it to setup_s so throughput numbers
+                # stay honest (same accounting as run_tpu's phases)
+                t0 = time.perf_counter()
+                session.engine.ensure_compiled(session.grid, steps)
+                t1 = time.perf_counter()
+                session.setup_s += t1 - t0
+                # step donates the input buffer: replace the reference
+                grid = session.engine.step(session.grid, steps)
+                jax.block_until_ready(grid)
+                session.grid = grid
+                session.steady_s += time.perf_counter() - t1
+            else:
+                t0 = time.perf_counter()
+                session.grid = session.stepper(session.grid, steps)
+                session.steady_s += time.perf_counter() - t0
+            session.generation += steps
+            return {"id": sid, "generation": session.generation,
+                    "steps": steps}
+
+    def snapshot(self, sid: str) -> dict:
+        session = self.get(sid)
+        with session.lock:
+            if session.closed:
+                raise KeyError(sid)
+            if session.engine is not None:
+                grid = session.engine.fetch(session.grid)
+                if grid is None:
+                    raise ConfigError(
+                        "snapshot over HTTP needs single-host execution")
+            else:
+                grid = session.grid
+        rows = ["".join("1" if v else "0" for v in row) for row in
+                np.asarray(grid, dtype=np.uint8)]
+        return {"id": sid, "generation": session.generation,
+                "rows": session.config.rows, "cols": session.config.cols,
+                "grid": rows}
+
+    def density(self, sid: str) -> dict:
+        session = self.get(sid)
+        with session.lock:
+            if session.closed:
+                raise KeyError(sid)
+            if session.engine is not None:
+                pop = session.engine.population(session.grid)
+            else:
+                pop = int(np.asarray(session.grid, dtype=np.int64).sum())
+        return {"id": sid, "generation": session.generation,
+                "population": pop,
+                "density": pop / session.config.cells}
+
+    # -- introspection -----------------------------------------------------
+
+    def describe(self, session: Session) -> dict:
+        d = {
+            "id": session.id,
+            "backend": session.config.backend,
+            "rows": session.config.rows,
+            "cols": session.config.cols,
+            "rule": str(session.config.rule),
+            "boundary": session.config.boundary,
+            "generation": session.generation,
+            "throughput": session.throughput(),
+        }
+        if session.engine is not None:
+            d["cache_hit"] = session.cache_hit
+            d["engine_compiles"] = session.engine.compile_count
+            d["engine_notes"] = list(session.engine.notes)
+        return d
+
+    def stats(self) -> dict:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return {
+            "cache": self.cache.stats(),
+            "sessions": [self.describe(s) for s in sessions],
+        }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)

@@ -19,6 +19,41 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache: the suite's cost on a small CPU box is
+# almost entirely XLA:CPU optimization of big shard_map programs (a
+# single sharded LtL test compiles for ~30s cold, ~5s warm).  Repo-local
+# (gitignored) so repeat runs — including the tier-1 verify — reuse it.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# Tier-1 ("-m 'not slow'") budget control.  The node ids in
+# tier1_slow_ids.txt are sharded-engine tests that need minutes of XLA:CPU
+# compilation each (bitpacked LtL, fused Pallas-interpret parity, engine
+# fuzzing) or spawn multi-process runs XLA:CPU cannot execute (multihost).
+# They run in the unfiltered suite; tier-1 keeps the fast sharded coverage
+# (test_parallel / test_cli / test_padwidth / test_seam) plus everything
+# single-device.
+_SLOW_IDS_FILE = os.path.join(os.path.dirname(__file__), "tier1_slow_ids.txt")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-minute compile-bound tests, excluded from tier-1"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    with open(_SLOW_IDS_FILE) as fh:
+        slow_ids = {ln.strip() for ln in fh if ln.strip() and not ln.startswith("#")}
+    for item in items:
+        if item.nodeid in slow_ids:
+            item.add_marker(pytest.mark.slow)

@@ -1,0 +1,226 @@
+"""Tier-1 tests for ``mpi_tpu.serve`` — cache semantics, session parity
+against the serial oracle, and the HTTP round trip, all on CPU devices
+(conftest pins JAX_PLATFORMS=cpu with 8 virtual devices).
+
+The acceptance criterion lives in ``test_second_session_zero_compiles``:
+creating a second session with an identical plan signature must perform
+zero new XLA compiles, observed through the EngineCache counters and
+``Engine.compile_count``.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.config import ConfigError, GolConfig, plan_signature
+from mpi_tpu.models.rules import LIFE, rule_from_name
+from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+
+
+# ---------------------------------------------------------------- cache
+
+
+def test_cache_hit_miss_counters():
+    built = []
+    cache = EngineCache(max_size=4)
+
+    def factory(tag):
+        def build():
+            built.append(tag)
+            return object()
+        return build
+
+    e1, hit1 = cache.get_or_build(("a",), factory("a"))
+    e2, hit2 = cache.get_or_build(("a",), factory("a"))
+    assert (hit1, hit2) == (False, True)
+    assert e1 is e2
+    assert built == ["a"]  # the hit never ran the factory
+    s = cache.stats()
+    assert (s["hits"], s["misses"], s["evictions"], s["size"]) == (1, 1, 0, 1)
+
+
+def test_cache_lru_eviction():
+    cache = EngineCache(max_size=2)
+    cache.get_or_build(("a",), lambda: "A")
+    cache.get_or_build(("b",), lambda: "B")
+    cache.get_or_build(("a",), lambda: "A")      # touch a: b is now LRU
+    cache.get_or_build(("c",), lambda: "C")      # evicts b
+    assert ("a",) in cache and ("c",) in cache
+    assert ("b",) not in cache
+    assert cache.stats()["evictions"] == 1
+    # b rebuilds as a miss, evicting the new LRU (a)
+    _, hit = cache.get_or_build(("b",), lambda: "B")
+    assert not hit
+    assert ("a",) not in cache
+
+
+def test_cache_rejects_bad_size():
+    with pytest.raises(ValueError):
+        EngineCache(max_size=0)
+
+
+def test_plan_signature_ignores_seed_and_steps():
+    a = GolConfig(rows=64, cols=64, steps=10, seed=0)
+    b = GolConfig(rows=64, cols=64, steps=99, seed=7, snapshot_every=5)
+    assert plan_signature(a, (2, 4)) == plan_signature(b, (2, 4))
+    c = GolConfig(rows=64, cols=64, steps=10, boundary="dead")
+    assert plan_signature(a, (2, 4)) != plan_signature(c, (2, 4))
+    assert plan_signature(a, (2, 4)) != plan_signature(a, (1, 8))
+    assert plan_signature(a, (2, 4), [1]) != plan_signature(a, (2, 4), [2])
+    hash(plan_signature(a, (2, 4), [1, 2]))     # must be hashable
+
+
+# -------------------------------------------------------------- sessions
+
+
+def _oracle(rows, cols, seed, steps, boundary="periodic", rule=LIFE):
+    return evolve_np(init_tile_np(rows, cols, seed), steps, rule, boundary)
+
+
+def _grid_of(snap):
+    return np.array([[int(c) for c in row] for row in snap["grid"]],
+                    dtype=np.uint8)
+
+
+def test_two_sessions_step_independently_tpu():
+    mgr = SessionManager(EngineCache(max_size=4))
+    a = mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "seed": 3})
+    b = mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "seed": 11})
+    # interleaved stepping: each board advances on its own clock
+    mgr.step(a["id"], 3)
+    mgr.step(b["id"], 5)
+    mgr.step(a["id"], 2)
+    snap_a, snap_b = mgr.snapshot(a["id"]), mgr.snapshot(b["id"])
+    assert snap_a["generation"] == 5 and snap_b["generation"] == 5
+    assert np.array_equal(_grid_of(snap_a), _oracle(64, 64, 3, 5))
+    assert np.array_equal(_grid_of(snap_b), _oracle(64, 64, 11, 5))
+    # density agrees with the snapshot it describes
+    d = mgr.density(a["id"])
+    assert d["population"] == int(_grid_of(snap_a).sum())
+    assert d["density"] == pytest.approx(d["population"] / (64 * 64))
+
+
+def test_serial_backend_session_parity():
+    mgr = SessionManager()
+    info = mgr.create({"rows": 48, "cols": 48, "backend": "serial",
+                       "seed": 2, "rule": "highlife", "boundary": "dead"})
+    mgr.step(info["id"], 7)
+    snap = mgr.snapshot(info["id"])
+    ref = _oracle(48, 48, 2, 7, boundary="dead",
+                  rule=rule_from_name("highlife"))
+    assert np.array_equal(_grid_of(snap), ref)
+
+
+def test_second_session_zero_compiles():
+    """Acceptance criterion: identical plan signature → zero new XLA
+    compiles on the second create (the whole point of the cache)."""
+    mgr = SessionManager(EngineCache(max_size=4))
+    spec = {"rows": 64, "cols": 64, "backend": "tpu", "segments": [1, 4]}
+    first = mgr.create(dict(spec))
+    compiles_after_first = first["engine_compiles"]
+    assert compiles_after_first >= 1            # the miss really compiled
+    second = mgr.create(dict(spec, seed=5))     # seed is not in the key
+    assert second["cache_hit"] and not first["cache_hit"]
+    assert second["engine_compiles"] == compiles_after_first
+    s = mgr.cache.stats()
+    assert (s["hits"], s["misses"]) == (1, 1)
+    # stepping both sessions at a precompiled depth adds no compiles either
+    mgr.step(first["id"], 4)
+    mgr.step(second["id"], 4)
+    assert mgr.stats()["sessions"][0]["engine_compiles"] == compiles_after_first
+
+
+def test_session_errors():
+    mgr = SessionManager()
+    with pytest.raises(ConfigError):
+        mgr.create({"rows": 32})                # missing cols
+    with pytest.raises(ConfigError):
+        mgr.create({"rows": 32, "cols": 32, "bogus": 1})
+    with pytest.raises(KeyError):
+        mgr.step("nope", 1)
+    info = mgr.create({"rows": 32, "cols": 32, "backend": "serial"})
+    with pytest.raises(ConfigError):
+        mgr.step(info["id"], 0)
+    mgr.close(info["id"])
+    with pytest.raises(KeyError):
+        mgr.snapshot(info["id"])
+
+
+# ------------------------------------------------------------------ HTTP
+
+
+@pytest.fixture()
+def server():
+    from mpi_tpu.serve.httpd import make_server
+
+    srv = make_server(port=0)                   # ephemeral port
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+    thread.join(timeout=5)
+
+
+def _req(srv, method, path, body=None):
+    host, port = srv.server_address[:2]
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_round_trip(server):
+    status, health = _req(server, "GET", "/healthz")
+    assert status == 200 and health["ok"]
+
+    status, created = _req(server, "POST", "/sessions",
+                           {"rows": 48, "cols": 48, "backend": "serial",
+                            "seed": 9})
+    assert status == 200
+    sid = created["id"]
+
+    status, stepped = _req(server, "POST", f"/sessions/{sid}/step",
+                           {"steps": 6})
+    assert status == 200 and stepped["generation"] == 6
+
+    status, snap = _req(server, "GET", f"/sessions/{sid}/snapshot")
+    assert status == 200
+    assert np.array_equal(_grid_of(snap), _oracle(48, 48, 9, 6))
+
+    status, stats = _req(server, "GET", "/stats")
+    assert status == 200
+    assert stats["sessions"][0]["id"] == sid
+    assert stats["sessions"][0]["generation"] == 6
+    assert stats["sessions"][0]["throughput"]["gens_per_s"] > 0
+    assert "hits" in stats["cache"]
+
+    status, closed = _req(server, "DELETE", f"/sessions/{sid}")
+    assert status == 200 and closed["closed"]
+    status, _ = _req(server, "GET", f"/sessions/{sid}/density")
+    assert status == 404
+
+
+def test_http_errors(server):
+    assert _req(server, "GET", "/nope")[0] == 404
+    assert _req(server, "POST", "/sessions", {"rows": 16})[0] == 400
+    status, err = _req(server, "POST", "/sessions",
+                       {"rows": 16, "cols": 16, "backend": "serial",
+                        "typo_knob": 1})
+    assert status == 400 and "typo_knob" in err["error"]
+    # step body must carry an int
+    _, created = _req(server, "POST", "/sessions",
+                      {"rows": 16, "cols": 16, "backend": "serial"})
+    assert _req(server, "POST", f"/sessions/{created['id']}/step",
+                {"steps": "three"})[0] == 400

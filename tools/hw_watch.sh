@@ -22,8 +22,10 @@ while true; do
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe=${plat:-error}" >> "$LOG"
   # MARK is round-scoped the same way the queue's .done markers are: a
   # marker older than VERDICT.md belongs to a finished previous round
-  # and must not block this round's queue
-  if [ "${plat:-}" = "tpu" ] && { [ ! -e "$MARK" ] || [ VERDICT.md -nt "$MARK" ]; }; then
+  # and must not block this round's queue.  No VERDICT.md yet (fresh
+  # round, file not written) must also unblock: -nt is false when the
+  # left file is absent, so a stale marker would gate the queue forever.
+  if [ "${plat:-}" = "tpu" ] && { [ ! -e "$MARK" ] || [ ! -e VERDICT.md ] || [ VERDICT.md -nt "$MARK" ]; }; then
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel healthy — running hw_session" >> "$LOG"
     # append with a window header: the queue spans multiple windows by
     # design, and a later degrading window must not erase the record of

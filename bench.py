@@ -834,11 +834,99 @@ def serve_bench() -> None:
     print(json.dumps(out))
 
 
+def serve_bench_batched() -> None:
+    """`python bench.py --serve-batched`: the microbatch amortization sweep.
+
+    Creates B same-signature sessions (B in {1, 2, 4, 8}) on a small
+    dispatch-bound board and steps them concurrently through the
+    scheduler for a few timed rounds, reporting per-board step latency
+    and the scheduler's amortized dispatch cost at each width.  The
+    point of the whole batched path is that per-board latency FALLS as B
+    grows (one stacked dispatch instead of B solo ones — PERF.md's
+    ~68 ms fixed tunnel cost divided by B); a compile-warming round runs
+    before the counters are reset so the timed rounds measure stepping,
+    not XLA.  One JSON line, errors in the "error" field.
+    """
+    out = {"bench": "serve_batched", "ok": False}
+    try:
+        import threading
+
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.session import SessionManager
+
+        # small board so the run is dispatch-bound: per-board compute is
+        # negligible next to the fixed per-call cost, which is the regime
+        # the scheduler targets (PERF.md's 68 ms tunnel cost on TPU; the
+        # interpreter+runtime per-dispatch floor here on CPU)
+        spec = {"rows": 64, "cols": 64, "backend": "tpu",
+                "boundary": "periodic"}
+        widths = [1, 2, 4, 8]
+        rounds = 10
+        sweep = []
+        for B in widths:
+            # generous window: on an oversubscribed CPU host, thread
+            # wakeup jitter alone can exceed a few ms, and a board that
+            # misses the window steps solo and poisons the measurement
+            mgr = SessionManager(EngineCache(max_size=4),
+                                 batch_window_ms=50.0, batch_max=B)
+            sids = [mgr.create(dict(spec, seed=s))["id"] for s in range(B)]
+
+            def one_round():
+                errs = []
+
+                def go(sid):
+                    try:
+                        mgr.step(sid, 1)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                ts = [threading.Thread(target=go, args=(s,)) for s in sids]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if errs:
+                    raise errs[0]
+
+            one_round()                     # warm the (depth, B) compile
+            mgr.batcher.reset_stats()
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                one_round()
+            wall = time.perf_counter() - t0
+            st = mgr.batcher.stats()
+            boards = st["batched_boards"] + st["solo_steps"]
+            step_s = st["batched_step_s"] + st["solo_step_s"]
+            sweep.append({
+                "B": B,
+                "rounds": rounds,
+                "boards_stepped": boards,
+                "coalesced_calls": st["coalesced_calls"],
+                "avg_occupancy": st["avg_occupancy"],
+                "solo_steps": st["solo_steps"],
+                "per_board_step_ms": round(step_s / boards * 1e3, 4),
+                "amortized_dispatch_ms": (
+                    round(st["batched_step_s"] / st["batched_boards"] * 1e3, 4)
+                    if st["batched_boards"] else None
+                ),
+                "wall_per_round_ms": round(wall / rounds * 1e3, 4),
+            })
+        out.update(ok=True, widths=widths, sweep=sweep)
+        per_board = [s["per_board_step_ms"] for s in sweep]
+        out["per_board_decreasing"] = all(
+            a > b for a, b in zip(per_board, per_board[1:]))
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
         serve_bench()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-batched":
+        serve_bench_batched()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":

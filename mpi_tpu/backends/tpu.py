@@ -12,6 +12,7 @@ the timing reports stay comparable.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Callable, List, Optional, Tuple
 
@@ -377,7 +378,21 @@ class Engine:
     so any number of sessions can share one engine.  Segment executables
     compile lazily per distinct length and memoize in ``_compiled``;
     ``compile_count`` counts real XLA compiles (the serve layer's
-    zero-recompile-on-cache-hit assertion reads it)."""
+    zero-recompile-on-cache-hit assertion reads it).
+
+    Batched stepping (the serve layer's microbatch scheduler): a stacked
+    ``[B, ...]`` batch of same-plan boards advances through ONE device
+    dispatch via ``step_batched`` — ``jax.vmap`` over the board axis of
+    the same evolve program (seam/halo logic is per-board, so vmap
+    composes with the sharded steppers; the batch axis is replicated
+    over the mesh while each board keeps the usual (i, j) sharding).
+    Small boards are dispatch-bound (~68 ms fixed per call over the
+    tunnel, PERF.md), so B boards per call amortize that fixed cost to
+    68/B ms per board.  Batched executables memoize per ``(depth, B)``
+    in ``_compiled_batched`` with the same donation and Pallas
+    compile-fallback discipline as the solo table;
+    ``step_calls``/``batched_step_calls`` count device dispatches (the
+    scheduler's one-dispatch-per-coalesced-batch assertion reads them)."""
 
     def __init__(self, config: GolConfig, mesh, evolve, *, bitpacked: bool,
                  cols_eff: int, pad_bits: int, used_pallas: bool,
@@ -395,8 +410,15 @@ class Engine:
         self._used_pallas = used_pallas
         self._fallback_factory = fallback_factory
         self._compiled = {}
+        self._compiled_batched = {}
+        self._evolve_batched = None
+        self._stack_fn = None
+        self._unstack_fn = None
         self._compile_lock = threading.Lock()
         self.compile_count = 0
+        self.batched_compile_count = 0
+        self.step_calls = 0
+        self.batched_step_calls = 0
         self._unpacker = None
 
     @property
@@ -436,18 +458,43 @@ class Engine:
         c = self._compiled.get(n)
         if c is not None:
             return c
-        with self._compile_lock:
-            return self._compile_locked(grid, n)
-
-    def _compile_locked(self, grid, n: int):
         # serve sessions share one engine across HTTP handler threads; a
         # race here would double-compile AND double-count (the cache's
         # zero-recompile assertion reads compile_count)
-        c = self._compiled.get(n)
+        with self._compile_lock:
+            c = self._compiled.get(n)
+            if c is not None:
+                return c
+            c = self._compile_with_fallback(
+                lambda: self._evolve.lower(grid, n).compile())
+            self._compiled[n] = c
+            self.compile_count += 1
+            return c
+
+    def ensure_compiled_batched(self, grids, n: int):
+        """Batched analog of :meth:`ensure_compiled`: the executable
+        advancing a stacked ``[B, ...]`` batch by ``n`` generations,
+        memoized per ``(n, B)`` with the same lock/fallback/counting
+        discipline (``compile_count`` covers both tables — the serve
+        layer's zero-recompile assertions read one counter)."""
+        key = (n, int(grids.shape[0]))
+        c = self._compiled_batched.get(key)
         if c is not None:
             return c
+        with self._compile_lock:
+            c = self._compiled_batched.get(key)
+            if c is not None:
+                return c
+            c = self._compile_with_fallback(
+                lambda: self._get_batched_evolve().lower(grids, n).compile())
+            self._compiled_batched[key] = c
+            self.compile_count += 1
+            self.batched_compile_count += 1
+            return c
+
+    def _compile_with_fallback(self, compile_fn):
         try:
-            c = self._evolve.lower(grid, n).compile()
+            return compile_fn()
         except Exception as e:  # noqa: BLE001 — Mosaic/VMEM errors vary by version
             if not self._used_pallas:
                 raise
@@ -464,12 +511,30 @@ class Engine:
             # drop Pallas-built executables so every depth reruns through
             # the one fallback stepper (outputs are bit-identical either
             # way — the parity suite proves it — but one program is easier
-            # to reason about than a mixed table)
+            # to reason about than a mixed table); the batched table vmaps
+            # over _evolve, so it must drop and re-derive too
             self._compiled.clear()
-            c = self._evolve.lower(grid, n).compile()
-        self._compiled[n] = c
-        self.compile_count += 1
-        return c
+            self._compiled_batched.clear()
+            self._evolve_batched = None
+            return compile_fn()
+
+    def _get_batched_evolve(self):
+        """evolve_batched(grids, steps): vmap of this engine's evolve over
+        a stacked leading board axis.  Rebuilt from the CURRENT ``_evolve``
+        (the compile fallback may have swapped it) and jitted with the
+        input batch donated — the scheduler stacks a fresh buffer per
+        coalesced call, so donating it costs nothing and keeps peak HBM at
+        one batch, same as the solo path."""
+        if self._evolve_batched is None:
+            base = self._evolve
+
+            @functools.partial(jax.jit, static_argnames=("steps",),
+                               donate_argnums=0)
+            def evolve_batched(grids, steps: int):
+                return jax.vmap(lambda g: base(g, steps))(grids)
+
+            self._evolve_batched = evolve_batched
+        return self._evolve_batched
 
     def compile_segments(self, grid, segments) -> None:
         """Ahead-of-time compile every distinct segment length (compilation
@@ -485,7 +550,79 @@ class Engine:
         replace their reference with the returned grid."""
         if n <= 0:
             return grid
-        return self.ensure_compiled(grid, n)(grid)
+        c = self.ensure_compiled(grid, n)
+        self.step_calls += 1
+        return c(grid)
+
+    # -- batched stepping (vmapped multi-board serving hot path) ----------
+
+    def batched_sharding(self):
+        """Sharding of a stacked ``[B, ...]`` batch: the board axis is
+        replicated, each board keeps this engine's (i, j) grid sharding."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from mpi_tpu.parallel.mesh import AXES
+
+        return NamedSharding(self.mesh, PartitionSpec(None, *AXES))
+
+    def stack_grids(self, grids):
+        """One ``[B, ...]`` device batch from B per-board grids (a single
+        fused dispatch, not B copies; jit retraces per batch width)."""
+        import jax.numpy as jnp
+
+        if self._stack_fn is None:
+            self._stack_fn = jax.jit(
+                lambda gs: jnp.stack(gs), out_shardings=self.batched_sharding()
+            )
+        return self._stack_fn(list(grids))
+
+    def unstack_grids(self, batched):
+        """The B per-board grids of a stacked batch, each back on the
+        plain grid sharding (one dispatch with B outputs — the scatter
+        half of the scheduler's stack/step/scatter cycle)."""
+        from mpi_tpu.parallel.step import grid_sharding
+
+        if self._unstack_fn is None:
+            self._unstack_fn = jax.jit(
+                lambda b: tuple(b[i] for i in range(b.shape[0])),
+                out_shardings=grid_sharding(self.mesh),
+            )
+        return list(self._unstack_fn(batched))
+
+    def init_grids(self, seeds=None, initials=None):
+        """A fresh stacked ``[B, ...]`` batch: one board per entry of
+        ``seeds`` (hash init) or ``initials`` (checkpoint grids)."""
+        if initials is not None:
+            boards = [self.init_grid(initial=i) for i in initials]
+        else:
+            boards = [self.init_grid(seed=s) for s in seeds]
+        return self.stack_grids(boards)
+
+    def step_batched(self, grids, n: int):
+        """Advance a stacked ``[B, ...]`` batch by ``n`` generations in ONE
+        device dispatch (compiling per new ``(n, B)``).  The batch buffer
+        is donated — callers must replace their reference with the
+        returned batch (per-board grids from :meth:`unstack_grids`)."""
+        if n <= 0:
+            return grids
+        c = self.ensure_compiled_batched(grids, n)
+        self.batched_step_calls += 1
+        return c(grids)
+
+    def batched_stepper(self, B: int):
+        """A ``step(grids, n)`` callable pinned to batch width ``B`` — the
+        value the serve layer's batched sub-cache holds per
+        ``(plan_signature, B)``; compiled executables still memoize here
+        per ``(n, B)``, so a cache hit costs zero new XLA compiles."""
+        def step(grids, n):
+            if int(grids.shape[0]) != B:
+                raise ValueError(
+                    f"batched stepper built for B={B}, got {grids.shape[0]}")
+            return self.step_batched(grids, n)
+
+        step.B = B
+        step.engine = self
+        return step
 
     def _get_unpacker(self):
         if self._unpacker is None and self.bitpacked:
@@ -529,6 +666,37 @@ class Engine:
         else:
             per_row = jnp.sum(grid.astype(jnp.uint32), axis=1)
         return int(np.asarray(jax.device_get(per_row), dtype=np.int64).sum())
+
+    def population_batched(self, grids) -> List[int]:
+        """Per-board live-cell counts of a stacked batch — one device
+        reduction to a ``[B, rows]`` vector, host-summed in int64 (the
+        same overflow discipline as :meth:`population`)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self.bitpacked:
+            per_row = jnp.sum(
+                lax.population_count(grids).astype(jnp.uint32), axis=2)
+        else:
+            per_row = jnp.sum(grids.astype(jnp.uint32), axis=2)
+        host = np.asarray(jax.device_get(per_row), dtype=np.int64)
+        return [int(v) for v in host.sum(axis=1)]
+
+    def fetch_batched(self, grids) -> Optional[List[np.ndarray]]:
+        """Per-board host numpy arrays of a stacked batch, each cropped to
+        the real width (None under multi-host execution — same contract
+        as :meth:`fetch`)."""
+        if jax.process_count() > 1:
+            return None
+        final = np.asarray(jax.device_get(grids))
+        if self.bitpacked:
+            from mpi_tpu.ops.bitlife import unpack_np
+
+            boards = [unpack_np(b) for b in final]
+            if self.pad_bits:
+                boards = [b[:, : self.config.cols] for b in boards]
+            return boards
+        return [np.asarray(b) for b in final]
 
 
 def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:

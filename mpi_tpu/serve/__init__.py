@@ -8,10 +8,18 @@ owns N independent boards with device-resident state between requests,
 and a stdlib-only HTTP front end (``httpd``) exposes the session verbs —
 the serving layer the ROADMAP's north star needs on top of the batch
 engine.  ``mpi_tpu serve`` (``serve/cli.py``) wires it together.
+
+A :class:`MicroBatcher` (``serve/batch.py``) sits on the step path:
+concurrent same-signature same-depth steps are coalesced into one stacked
+``[B, ...]`` dispatch through the engine's vmapped batched stepper,
+amortizing the fixed per-dispatch tunnel cost (PERF.md: ~68 ms) across B
+boards.  Batching is transparent — results are bitwise identical to solo
+stepping and any batched-path failure falls back to the solo path.
 """
 
+from mpi_tpu.serve.batch import MicroBatcher
 from mpi_tpu.serve.cache import EngineCache
 from mpi_tpu.serve.session import SessionManager
 from mpi_tpu.serve.httpd import make_server
 
-__all__ = ["EngineCache", "SessionManager", "make_server"]
+__all__ = ["EngineCache", "MicroBatcher", "SessionManager", "make_server"]

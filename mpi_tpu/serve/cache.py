@@ -7,6 +7,13 @@ program depends on (``mpi_tpu.config.plan_signature``) can share one
 The cache makes "create a second board of the same shape" cost zero new
 XLA compiles — the acceptance criterion ``tests/test_serve.py`` asserts
 via the counters here plus ``Engine.compile_count``.
+
+A second, batched sub-cache rides along for the microbatch scheduler
+(``serve/batch.py``): vmapped batched steppers keyed by
+``(plan_signature, B)`` with their own hit/miss/eviction counters, so a
+second coalesced batch of the same signature and width reuses the
+stepper handle (and, through ``Engine``'s per-``(depth, B)`` executable
+table, costs zero new XLA compiles).
 """
 
 from __future__ import annotations
@@ -35,8 +42,17 @@ class EngineCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.batched_hits = 0
+        self.batched_misses = 0
+        self.batched_evictions = 0
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        # batched steppers are far cheaper than engines (a handle over an
+        # engine the main table already holds), but the bound still keeps
+        # a signature churn from growing the table without limit; one
+        # entry per (signature, B) — 4 widths per signature by default
+        self.batched_max_size = max_size * 4
+        self._batched: "OrderedDict[tuple, object]" = OrderedDict()
 
     def get_or_build(self, signature: tuple,
                      factory: Callable[[], object]) -> Tuple[object, bool]:
@@ -59,6 +75,27 @@ class EngineCache:
                 self.evictions += 1
             return eng, False
 
+    def get_or_build_batched(self, signature: tuple, B: int,
+                             factory: Callable[[], object]) -> Tuple[object, bool]:
+        """(stepper, hit) for the batched sub-cache, keyed
+        ``(signature, B)`` — same inside-the-lock factory discipline as
+        :meth:`get_or_build` (concurrent coalesced batches of one shape
+        must not both build), same LRU beyond ``batched_max_size``."""
+        key = (signature, int(B))
+        with self._lock:
+            stepper = self._batched.get(key)
+            if stepper is not None:
+                self._batched.move_to_end(key)
+                self.batched_hits += 1
+                return stepper, True
+            self.batched_misses += 1
+            stepper = factory()
+            self._batched[key] = stepper
+            while len(self._batched) > self.batched_max_size:
+                self._batched.popitem(last=False)
+                self.batched_evictions += 1
+            return stepper, False
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -75,4 +112,11 @@ class EngineCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "batched": {
+                    "size": len(self._batched),
+                    "max_size": self.batched_max_size,
+                    "hits": self.batched_hits,
+                    "misses": self.batched_misses,
+                    "evictions": self.batched_evictions,
+                },
             }

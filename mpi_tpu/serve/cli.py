@@ -27,6 +27,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="0 binds an ephemeral port (printed on startup)")
     p.add_argument("--cache-size", type=int, default=8,
                    help="max cached compiled engines (LRU beyond this)")
+    p.add_argument("--batch-window-ms", type=float, default=2.0,
+                   help="coalescing window for same-signature concurrent "
+                   "steps: the first arrival waits this long collecting "
+                   "peers before dispatching one stacked batched step "
+                   "(0 disables the wait but still coalesces whatever is "
+                   "already queued)")
+    p.add_argument("--batch-max", type=int, default=8,
+                   help="max boards per stacked batched dispatch")
+    p.add_argument("--no-batch", action="store_true",
+                   help="disable microbatching; every step dispatches solo")
     p.add_argument("--verbose", action="store_true",
                    help="log one line per HTTP request")
     return p
@@ -42,14 +52,21 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 
     apply_platform_override()
     try:
-        manager = SessionManager(EngineCache(max_size=args.cache_size))
+        manager = SessionManager(
+            EngineCache(max_size=args.cache_size),
+            batching=not args.no_batch,
+            batch_window_ms=args.batch_window_ms,
+            batch_max=args.batch_max,
+        )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     server = make_server(args.host, args.port, manager, verbose=args.verbose)
     host, port = server.server_address[:2]
+    batch = ("off" if args.no_batch else
+             f"window {args.batch_window_ms}ms max {args.batch_max}")
     print(f"[mpi_tpu] serving on http://{host}:{port} "
-          f"(cache size {args.cache_size})", flush=True)
+          f"(cache size {args.cache_size}, batch {batch})", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

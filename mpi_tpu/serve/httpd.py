@@ -9,12 +9,15 @@ Routes (all bodies and responses are JSON):
     DELETE /sessions/<id>              close the board
     GET    /healthz                    liveness probe
     GET    /stats                      cache counters + per-session throughput
+                                       + microbatch occupancy/amortization
+                                       (the ``batch`` section, when enabled)
 
 Errors: 400 with {"error": ...} for bad specs/bodies (``ConfigError``/
 ``ValueError``), 404 for unknown sessions and routes.  The server is a
 ``ThreadingHTTPServer`` — requests against different boards run
 concurrently; the per-session locks in ``session.py`` serialize requests
-against the same board.
+against the same board, and concurrent same-signature step requests are
+coalesced into stacked batched dispatches by ``serve/batch.py``.
 """
 
 from __future__ import annotations

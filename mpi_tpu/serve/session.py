@@ -13,6 +13,13 @@ cached :class:`~mpi_tpu.backends.tpu.Engine` plus their own grid buffer,
 so N boards of the same shape share one compiled stepper.  Eviction from
 the :class:`~mpi_tpu.serve.cache.EngineCache` only drops the cache's
 reference — live sessions keep theirs.
+
+Stepping routes through the :class:`~mpi_tpu.serve.batch.MicroBatcher`
+(when enabled, the default): concurrent same-signature same-depth steps
+coalesce into one stacked ``Engine.step_batched`` dispatch — B boards
+pay ONE ~68 ms tunnel dispatch instead of B (PERF.md) — while lone
+requests, host backends, and any batched-path failure take the solo
+path, so batching only ever removes dispatches, never changes results.
 """
 
 from __future__ import annotations
@@ -25,6 +32,7 @@ import numpy as np
 
 from mpi_tpu.config import ConfigError, GolConfig, plan_signature
 from mpi_tpu.models.rules import rule_from_name
+from mpi_tpu.serve.batch import MicroBatcher
 from mpi_tpu.serve.cache import EngineCache
 
 _SPEC_KEYS = {
@@ -91,14 +99,16 @@ class Session:
 
     def __init__(self, sid: str, config: GolConfig, *, engine=None,
                  stepper=None, grid=None, cache_hit: bool = False,
-                 setup_s: float = 0.0):
+                 setup_s: float = 0.0, plan_sig=None):
         self.id = sid
         self.config = config
         self.engine = engine
         self.stepper = stepper
         self.grid = grid
         self.cache_hit = cache_hit
+        self.plan_sig = plan_sig        # batch-queue key (tpu sessions)
         self.generation = 0
+        self.batched_steps = 0          # steps served by a coalesced batch
         self.setup_s = setup_s          # plan + compile (grows if a step
         self.steady_s = 0.0             # needs a new depth); stepping time
         self.lock = threading.Lock()
@@ -118,15 +128,25 @@ class Session:
 
 
 class SessionManager:
-    """Owns the session table and the engine cache.
+    """Owns the session table, the engine cache, and the microbatcher.
 
     Single-host by design (multi-host serving is a ROADMAP open item):
     snapshot/density fetch through ``Engine.fetch``/``population``, which
     assume one process can address the whole array.
+
+    ``batching=False`` (or ``batch_window_ms=0`` with no concurrency)
+    degenerates to the PR-1 solo behavior; engine-backed steps otherwise
+    route through the :class:`~mpi_tpu.serve.batch.MicroBatcher`.
     """
 
-    def __init__(self, cache: Optional[EngineCache] = None):
+    def __init__(self, cache: Optional[EngineCache] = None, *,
+                 batching: bool = True, batch_window_ms: float = 2.0,
+                 batch_max: int = 8):
         self.cache = cache if cache is not None else EngineCache()
+        self.batcher = (
+            MicroBatcher(window_ms=batch_window_ms, max_batch=batch_max)
+            if batching else None
+        )
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._next = 0
@@ -161,7 +181,8 @@ class SessionManager:
         # precompile the requested segment set (a no-op on a cache hit —
         # the signature pins the set, so the hit engine already has it)
         engine.compile_segments(grid, segments)
-        return Session("?", config, engine=engine, grid=grid, cache_hit=hit)
+        return Session("?", config, engine=engine, grid=grid, cache_hit=hit,
+                       plan_sig=sig)
 
     def _create_host(self, config: GolConfig) -> Session:
         from mpi_tpu.utils.hashinit import init_tile_np
@@ -218,37 +239,54 @@ class SessionManager:
         if steps < 1:
             raise ConfigError(f"steps must be >= 1, got {steps}")
         session = self.get(sid)
+        if self.batcher is not None and session.engine is not None \
+                and session.plan_sig is not None:
+            # engine-backed steps coalesce: concurrent same-signature
+            # same-depth requests share ONE stacked device dispatch; the
+            # batcher takes session.lock (leader-side) and falls back to
+            # _step_locked when alone or on any batched-path failure
+            return self.batcher.submit(self, session, steps)
         with session.lock:
             if session.closed:
                 raise KeyError(sid)
-            if session.engine is not None:
-                import jax
+            return self._step_locked(session, steps)
 
-                # a depth never seen before compiles here — that is setup,
-                # not stepping; charge it to setup_s so throughput numbers
-                # stay honest (same accounting as run_tpu's phases)
-                t0 = time.perf_counter()
-                session.engine.ensure_compiled(session.grid, steps)
-                t1 = time.perf_counter()
-                session.setup_s += t1 - t0
-                # step donates the input buffer: replace the reference
-                grid = session.engine.step(session.grid, steps)
-                jax.block_until_ready(grid)
-                session.grid = grid
-                session.steady_s += time.perf_counter() - t1
-            else:
-                t0 = time.perf_counter()
-                session.grid = session.stepper(session.grid, steps)
-                session.steady_s += time.perf_counter() - t0
-            session.generation += steps
-            return {"id": sid, "generation": session.generation,
-                    "steps": steps}
+    def _step_locked(self, session: Session, steps: int) -> dict:
+        """The solo step body; caller holds ``session.lock`` (the HTTP
+        path via :meth:`step`, the microbatch leader for lone/fallback
+        entries)."""
+        if session.engine is not None:
+            import jax
+
+            # a depth never seen before compiles here — that is setup,
+            # not stepping; charge it to setup_s so throughput numbers
+            # stay honest (same accounting as run_tpu's phases)
+            t0 = time.perf_counter()
+            session.engine.ensure_compiled(session.grid, steps)
+            t1 = time.perf_counter()
+            session.setup_s += t1 - t0
+            # step donates the input buffer: replace the reference
+            grid = session.engine.step(session.grid, steps)
+            jax.block_until_ready(grid)
+            session.grid = grid
+            session.steady_s += time.perf_counter() - t1
+        else:
+            t0 = time.perf_counter()
+            session.grid = session.stepper(session.grid, steps)
+            session.steady_s += time.perf_counter() - t0
+        session.generation += steps
+        return {"id": session.id, "generation": session.generation,
+                "steps": steps}
 
     def snapshot(self, sid: str) -> dict:
         session = self.get(sid)
         with session.lock:
             if session.closed:
                 raise KeyError(sid)
+            # generation must be captured with the grid, INSIDE the lock —
+            # a concurrent step between fetch and return would otherwise
+            # label this grid with a later generation (torn read)
+            generation = session.generation
             if session.engine is not None:
                 grid = session.engine.fetch(session.grid)
                 if grid is None:
@@ -258,7 +296,7 @@ class SessionManager:
                 grid = session.grid
         rows = ["".join("1" if v else "0" for v in row) for row in
                 np.asarray(grid, dtype=np.uint8)]
-        return {"id": sid, "generation": session.generation,
+        return {"id": sid, "generation": generation,
                 "rows": session.config.rows, "cols": session.config.cols,
                 "grid": rows}
 
@@ -267,40 +305,54 @@ class SessionManager:
         with session.lock:
             if session.closed:
                 raise KeyError(sid)
+            # same torn-read discipline as snapshot: the generation and
+            # the population it describes leave the lock together
+            generation = session.generation
             if session.engine is not None:
                 pop = session.engine.population(session.grid)
             else:
                 pop = int(np.asarray(session.grid, dtype=np.int64).sum())
-        return {"id": sid, "generation": session.generation,
+        return {"id": sid, "generation": generation,
                 "population": pop,
                 "density": pop / session.config.cells}
 
     # -- introspection -----------------------------------------------------
 
     def describe(self, session: Session) -> dict:
-        d = {
-            "id": session.id,
-            "backend": session.config.backend,
-            "rows": session.config.rows,
-            "cols": session.config.cols,
-            "rule": str(session.config.rule),
-            "boundary": session.config.boundary,
-            "generation": session.generation,
-            "throughput": session.throughput(),
-        }
-        if session.engine is not None:
-            d["cache_hit"] = session.cache_hit
-            d["engine_compiles"] = session.engine.compile_count
-            d["engine_notes"] = list(session.engine.notes)
+        # snapshot every field under session.lock: a concurrent close()
+        # nulls session.engine, and a concurrent step bumps generation —
+        # reading them unlocked can tear (engine checked non-None, then
+        # dereferenced as None)
+        with session.lock:
+            engine = session.engine
+            d = {
+                "id": session.id,
+                "backend": session.config.backend,
+                "rows": session.config.rows,
+                "cols": session.config.cols,
+                "rule": str(session.config.rule),
+                "boundary": session.config.boundary,
+                "generation": session.generation,
+                "throughput": session.throughput(),
+            }
+            if engine is not None:
+                d["cache_hit"] = session.cache_hit
+                d["engine_compiles"] = engine.compile_count
+                d["engine_batched_compiles"] = engine.batched_compile_count
+                d["engine_notes"] = list(engine.notes)
+                d["batched_steps"] = session.batched_steps
         return d
 
     def stats(self) -> dict:
         with self._lock:
             sessions = list(self._sessions.values())
-        return {
+        out = {
             "cache": self.cache.stats(),
             "sessions": [self.describe(s) for s in sessions],
         }
+        if self.batcher is not None:
+            out["batch"] = self.batcher.stats()
+        return out
 
     def __len__(self) -> int:
         with self._lock:

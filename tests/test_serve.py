@@ -205,11 +205,273 @@ def test_http_round_trip(server):
     assert stats["sessions"][0]["generation"] == 6
     assert stats["sessions"][0]["throughput"]["gens_per_s"] > 0
     assert "hits" in stats["cache"]
+    assert "batched" in stats["cache"]          # batched sub-cache counters
+    assert "coalesced_calls" in stats["batch"]  # microbatch section
 
     status, closed = _req(server, "DELETE", f"/sessions/{sid}")
     assert status == 200 and closed["closed"]
     status, _ = _req(server, "GET", f"/sessions/{sid}/density")
     assert status == 404
+
+
+def test_cache_batched_sub_cache():
+    cache = EngineCache(max_size=2)
+    s1, hit1 = cache.get_or_build_batched(("a",), 4, lambda: "A4")
+    s2, hit2 = cache.get_or_build_batched(("a",), 4, lambda: "A4'")
+    s3, hit3 = cache.get_or_build_batched(("a",), 2, lambda: "A2")
+    assert (hit1, hit2, hit3) == (False, True, False)
+    assert s1 is s2 and s1 == "A4" and s3 == "A2"   # widths are distinct keys
+    b = cache.stats()["batched"]
+    assert (b["hits"], b["misses"], b["size"]) == (1, 2, 2)
+    # the batched table is bounded independently of the engine table
+    assert b["max_size"] == 2 * 4
+    for i in range(10):
+        cache.get_or_build_batched(("churn", i), 1, lambda: i)
+    b = cache.stats()["batched"]
+    assert b["size"] <= b["max_size"] and b["evictions"] > 0
+
+
+# ----------------------------------------------------------- batched engine
+
+
+def _build_engine(rows, cols, mesh_shape, **cfg):
+    from mpi_tpu.backends.tpu import build_engine
+    from mpi_tpu.parallel.mesh import make_mesh
+
+    config = GolConfig(rows=rows, cols=cols, steps=1,
+                       mesh_shape=mesh_shape, **cfg)
+    return build_engine(config, mesh=make_mesh(mesh_shape))
+
+
+def test_step_batched_parity_packed():
+    """B stacked boards through one vmapped dispatch must bit-match B
+    solo-stepped boards AND the numpy oracle (packed SWAR engine, sharded
+    (2, 4) mesh) — the tentpole's correctness criterion."""
+    eng = _build_engine(64, 64, (2, 4))
+    seeds, steps = [3, 11, 29], 5
+    grids = eng.init_grids(seeds=seeds)
+    calls0 = eng.batched_step_calls
+    grids = eng.step_batched(grids, steps)
+    assert eng.batched_step_calls == calls0 + 1
+    batched = eng.fetch_batched(grids)
+    pops = eng.population_batched(grids)
+    for seed, board, pop in zip(seeds, batched, pops):
+        solo = eng.step(eng.init_grid(seed=seed), steps)
+        assert np.array_equal(board, eng.fetch(solo))
+        assert np.array_equal(board, _oracle(64, 64, seed, steps))
+        assert pop == int(board.sum())
+
+
+def test_step_batched_second_batch_zero_compiles():
+    """Acceptance criterion: a second batch of the same (signature, B)
+    performs zero new XLA compiles (the per-(depth, B) executable table
+    is warm)."""
+    eng = _build_engine(64, 64, (2, 4))
+    g = eng.step_batched(eng.init_grids(seeds=[1, 2]), 4)
+    compiles = eng.compile_count
+    assert eng.batched_compile_count >= 1
+    g2 = eng.step_batched(eng.init_grids(seeds=[8, 9]), 4)
+    g2 = eng.step_batched(g2, 4)                 # same depth again too
+    assert eng.compile_count == compiles
+    del g, g2
+
+
+def test_step_batched_parity_dense():
+    """The dense (radius-2 LtL) engine batches too: vmap composes with
+    the unpacked stepper on a dead-boundary misaligned board."""
+    eng = _build_engine(32, 40, (1, 1),
+                       rule=rule_from_name("R2,B10-13,S9-14"),
+                       boundary="dead")
+    seeds, steps = [7, 13], 3
+    grids = eng.step_batched(eng.init_grids(seeds=seeds), steps)
+    rule = rule_from_name("R2,B10-13,S9-14")
+    for seed, board in zip(seeds, eng.fetch_batched(grids)):
+        ref = _oracle(32, 40, seed, steps, boundary="dead", rule=rule)
+        assert np.array_equal(board, ref)
+
+
+# -------------------------------------------------------- microbatch scheduler
+
+
+def _step_all_concurrently(mgr, sids, steps=1):
+    """Step every session from its own thread (the serving workload the
+    scheduler coalesces); re-raises the first worker error."""
+    results, errors = {}, []
+
+    def go(sid, n):
+        try:
+            results[sid] = mgr.step(sid, n)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=go, args=(s, steps)) for s in sids]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+def test_scheduler_coalesces_same_signature():
+    """Acceptance criterion: B same-signature sessions stepped
+    concurrently issue exactly ONE batched device call, and every
+    board's state matches the oracle."""
+    mgr = SessionManager(EngineCache(max_size=4),
+                         batch_window_ms=500.0, batch_max=8)
+    seeds = [1, 2, 3, 4]
+    sids = [mgr.create({"rows": 64, "cols": 64, "backend": "tpu",
+                        "seed": s})["id"] for s in seeds]
+    engine = mgr.get(sids[0]).engine
+    results = _step_all_concurrently(mgr, sids)
+    assert engine.batched_step_calls == 1       # ONE dispatch for the batch
+    assert engine.step_calls == 0               # nobody stepped solo
+    assert all(r["generation"] == 1 for r in results.values())
+    assert all(r.get("batched") == 4 for r in results.values())
+    st = mgr.stats()
+    assert st["batch"]["coalesced_calls"] == 1
+    assert st["batch"]["batched_boards"] == 4
+    assert st["batch"]["max_occupancy"] == 4
+    for seed, sid in zip(seeds, sids):
+        assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                              _oracle(64, 64, seed, 1))
+    # second coalesced round: same (signature, B) → zero new XLA compiles
+    compiles = engine.compile_count
+    _step_all_concurrently(mgr, sids)
+    assert engine.batched_step_calls == 2
+    assert engine.compile_count == compiles
+    b = mgr.cache.stats()["batched"]
+    assert b["hits"] >= 1 and b["misses"] == 1
+    desc = mgr.describe(mgr.get(sids[0]))
+    assert desc["batched_steps"] == 2
+    assert desc["engine_batched_compiles"] >= 1
+
+
+def test_scheduler_mixed_depths_do_not_coalesce():
+    """Different pending depths land in different queues — they must
+    never share a stacked dispatch (their compiled programs differ)."""
+    mgr = SessionManager(EngineCache(max_size=4),
+                         batch_window_ms=200.0, batch_max=8)
+    a = mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "seed": 5})
+    b = mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "seed": 6})
+    engine = mgr.get(a["id"]).engine
+    results, errors = {}, []
+
+    def go(sid, n):
+        try:
+            results[sid] = mgr.step(sid, n)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=go, args=(a["id"], 1)),
+               threading.Thread(target=go, args=(b["id"], 2))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert engine.batched_step_calls == 0       # depths 1 and 2 never mix
+    assert results[a["id"]]["generation"] == 1
+    assert results[b["id"]]["generation"] == 2
+    assert np.array_equal(_grid_of(mgr.snapshot(a["id"])),
+                          _oracle(64, 64, 5, 1))
+    assert np.array_equal(_grid_of(mgr.snapshot(b["id"])),
+                          _oracle(64, 64, 6, 2))
+
+
+def test_scheduler_duplicate_session_steps_twice():
+    """The same session submitted twice in one window must not occupy two
+    lanes of one stacked batch (both would step the same pre-grid); the
+    duplicate steps solo after, and the board advances exactly twice."""
+    mgr = SessionManager(EngineCache(max_size=4),
+                         batch_window_ms=300.0, batch_max=8)
+    sid = mgr.create({"rows": 64, "cols": 64, "backend": "tpu",
+                      "seed": 17})["id"]
+    _step_all_concurrently(mgr, [sid, sid])
+    session = mgr.get(sid)
+    assert session.generation == 2
+    assert session.engine.batched_step_calls == 0   # group of 1 → solo
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                          _oracle(64, 64, 17, 2))
+
+
+def test_scheduler_disabled_steps_solo():
+    mgr = SessionManager(EngineCache(max_size=4), batching=False)
+    sid = mgr.create({"rows": 64, "cols": 64, "backend": "tpu",
+                      "seed": 21})["id"]
+    r = mgr.step(sid, 2)
+    assert r["generation"] == 2 and "batched" not in r
+    assert "batch" not in mgr.stats()
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                          _oracle(64, 64, 21, 2))
+
+
+# ------------------------------------------------------------- races
+
+
+def test_snapshot_density_generation_not_torn():
+    """A snapshot's reported generation must label the grid it carries
+    even while another thread is stepping — the torn-read fix.  The
+    serial backend keeps each step slow enough (µs, not ns) that the
+    pre-fix race window (generation read after lock release) is hit
+    reliably within a few hundred snapshots."""
+    rows = cols = 32
+    total = 60
+    oracle = [init_tile_np(rows, cols, 4)]
+    for _ in range(total):
+        oracle.append(evolve_np(oracle[-1], 1, LIFE, "periodic"))
+    mgr = SessionManager()
+    sid = mgr.create({"rows": rows, "cols": cols, "backend": "serial",
+                      "seed": 4})["id"]
+    done = threading.Event()
+
+    def stepper():
+        for _ in range(total):
+            mgr.step(sid, 1)
+        done.set()
+
+    t = threading.Thread(target=stepper)
+    t.start()
+    try:
+        while not done.is_set():
+            snap = mgr.snapshot(sid)
+            assert np.array_equal(_grid_of(snap), oracle[snap["generation"]])
+            d = mgr.density(sid)
+            assert d["population"] == int(oracle[d["generation"]].sum())
+    finally:
+        t.join()
+    assert mgr.get(sid).generation == total
+
+
+def test_stats_describe_close_race():
+    """stats() must never observe a half-closed session (engine nulled
+    between the None-check and the dereference) — the describe fix."""
+    mgr = SessionManager()
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        try:
+            for _ in range(40):
+                info = mgr.create({"rows": 16, "cols": 16,
+                                   "backend": "serial"})
+                mgr.close(info["id"])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            stop.set()
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        while not stop.is_set():
+            st = mgr.stats()                    # must never raise
+            for s in st["sessions"]:
+                assert "id" in s
+    finally:
+        t.join()
+    assert not errors
 
 
 def test_http_errors(server):

@@ -420,6 +420,10 @@ class Engine:
         self.step_calls = 0
         self.batched_step_calls = 0
         self._unpacker = None
+        # optional callable(site) invoked just before each device dispatch
+        # ('step' | 'batched'); the serve layer installs its fault injector
+        # here so recovery paths are testable without sick hardware
+        self.fault_hook = None
 
     @property
     def col_limit(self):
@@ -527,9 +531,15 @@ class Engine:
         one batch, same as the solo path."""
         if self._evolve_batched is None:
             base = self._evolve
+            # seam-stitched programs must not donate their input: the
+            # band extraction reads the pre-step grid the base step would
+            # alias in place, which races on multi-device meshes (see
+            # make_seam_stepper) — the hazard vmaps along with the body
+            seam = self.pad_bits > 0 and self.config.boundary == "periodic"
+            jit_kwargs = {} if seam else {"donate_argnums": 0}
 
             @functools.partial(jax.jit, static_argnames=("steps",),
-                               donate_argnums=0)
+                               **jit_kwargs)
             def evolve_batched(grids, steps: int):
                 return jax.vmap(lambda g: base(g, steps))(grids)
 
@@ -551,6 +561,10 @@ class Engine:
         if n <= 0:
             return grid
         c = self.ensure_compiled(grid, n)
+        if self.fault_hook is not None:
+            # before the device call: an injected failure must leave the
+            # caller's grid untouched (the donation happens inside c)
+            self.fault_hook("step")
         self.step_calls += 1
         return c(grid)
 
@@ -606,6 +620,8 @@ class Engine:
         if n <= 0:
             return grids
         c = self.ensure_compiled_batched(grids, n)
+        if self.fault_hook is not None:
+            self.fault_hook("batched")
         self.batched_step_calls += 1
         return c(grids)
 

@@ -140,4 +140,11 @@ def make_seam_stepper(inner, rule: Rule, C: int, K: int):
 
         return step_k
 
-    return segmented_evolve(make_local, K)
+    # donate=False: the seam program reads the input grid twice — the
+    # shard_map'd base step and the band extraction — and input/output
+    # aliasing under that structure races on multi-device meshes (a
+    # shard's input word clobbered while the band slice still reads it;
+    # observed as nondeterministic whole-shard corruption on the
+    # 8-virtual-device CPU mesh).  Seam runs pay one extra grid buffer;
+    # the un-wrapped steppers keep their donation.
+    return segmented_evolve(make_local, K, donate=False)

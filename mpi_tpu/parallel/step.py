@@ -420,7 +420,11 @@ def make_sharded_bit_stepper(
 
         return local_step
 
-    return segmented_evolve(make_local, K)
+    # seam_pad steppers run nested under make_seam_stepper's jit, which
+    # still reads the pre-step grid for the band — they must not donate
+    # (see segmented_evolve: the aliasing hint races the band read on
+    # multi-device meshes); the outer seam jit carries the donation
+    return segmented_evolve(make_local, K, donate=not seam_pad)
 
 
 def make_sharded_ltl_stepper(
@@ -574,7 +578,11 @@ def make_sharded_ltl_stepper(
 
         return local_step
 
-    return segmented_evolve(make_local, K)
+    # seam_pad steppers run nested under make_seam_stepper's jit, which
+    # still reads the pre-step grid for the band — they must not donate
+    # (see segmented_evolve: the aliasing hint races the band read on
+    # multi-device meshes); the outer seam jit carries the donation
+    return segmented_evolve(make_local, K, donate=not seam_pad)
 
 
 def sharded_bit_init(mesh: Mesh, rows: int, cols: int, seed: int, axes=AXES,

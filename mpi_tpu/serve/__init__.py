@@ -15,11 +15,29 @@ concurrent same-signature same-depth steps are coalesced into one stacked
 amortizing the fixed per-dispatch tunnel cost (PERF.md: ~68 ms) across B
 boards.  Batching is transparent — results are bitwise identical to solo
 stepping and any batched-path failure falls back to the solo path.
+
+The fault-tolerance layer (PR 3) rides the same stack: crash-safe
+checkpoint/restore (``serve/recovery.py`` + ``--state-dir``), request
+deadlines with a dispatch watchdog, a per-plan-signature circuit breaker
+that degrades sick engines to the bit-identical ``serial_np`` oracle,
+and deterministic fault injection (``serve/faults.py``) to drive every
+recovery path under test.
 """
 
 from mpi_tpu.serve.batch import MicroBatcher
 from mpi_tpu.serve.cache import EngineCache
-from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.serve.faults import FaultInjector, FaultPlan, InjectedFault
+from mpi_tpu.serve.recovery import StateStore
+from mpi_tpu.serve.session import (
+    DeadlineError,
+    EngineStepError,
+    EngineUnavailableError,
+    SessionManager,
+)
 from mpi_tpu.serve.httpd import make_server
 
-__all__ = ["EngineCache", "MicroBatcher", "SessionManager", "make_server"]
+__all__ = [
+    "EngineCache", "MicroBatcher", "SessionManager", "make_server",
+    "StateStore", "FaultInjector", "FaultPlan", "InjectedFault",
+    "DeadlineError", "EngineStepError", "EngineUnavailableError",
+]

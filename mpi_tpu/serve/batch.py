@@ -249,8 +249,10 @@ class MicroBatcher:
             s.grid = grid
             s.generation += steps
             s.batched_steps += 1
+            manager._checkpoint(s)      # session lock is held (leader)
             e.result = {"id": s.id, "generation": s.generation,
                         "steps": steps, "batched": B}
+        manager._mark_dispatch_ok()
         with self._lock:
             self.coalesced_calls += 1
             self.batched_boards += B

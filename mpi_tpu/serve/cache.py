@@ -14,13 +14,47 @@ A second, batched sub-cache rides along for the microbatch scheduler
 second coalesced batch of the same signature and width reuses the
 stepper handle (and, through ``Engine``'s per-``(depth, B)`` executable
 table, costs zero new XLA compiles).
+
+The cache also owns the per-signature **circuit breakers** (PR 3): a
+plan signature that keeps failing is *quarantined* here — the natural
+home, because the signature IS the unit that shares one compiled
+engine, so every session riding a sick engine trips (and is protected
+by) the same breaker.  ``breaker_threshold`` consecutive failures open
+the breaker; ``breaker_cooldown_s`` later it goes half-open and admits
+one trial dispatch (success closes it, failure re-opens).  The session
+layer consults ``breaker_allows`` before engine dispatches and degrades
+affected sessions to the ``serial_np`` oracle while the breaker is
+open.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Tuple
+
+
+class _Breaker:
+    """Per-signature failure state (guarded by the cache lock)."""
+
+    __slots__ = ("failures", "opened_at", "trips")
+
+    def __init__(self):
+        self.failures = 0
+        self.opened_at = None           # monotonic time the breaker opened
+        self.trips = 0
+
+
+def signature_label(signature: tuple) -> str:
+    """A compact human-readable tag for a plan signature (stats/healthz
+    payloads must not ship a page of Rule repr per breaker)."""
+    try:
+        rows, cols, rule, boundary, backend, mesh = signature[:6]
+        return (f"{rows}x{cols}/{backend}/{boundary}/"
+                f"mesh{mesh[0]}x{mesh[1]}/{rule}")
+    except Exception:  # noqa: BLE001 — labels are cosmetic, never fatal
+        return str(signature)[:120]
 
 
 class EngineCache:
@@ -35,10 +69,20 @@ class EngineCache:
     where two *different* expensive plans arrive in the same instant).
     """
 
-    def __init__(self, max_size: int = 8):
+    def __init__(self, max_size: int = 8, *, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0):
         if max_size < 1:
             raise ValueError(f"max_size must be >= 1, got {max_size}")
+        if breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got {breaker_threshold}")
+        if breaker_cooldown_s < 0:
+            raise ValueError(
+                f"breaker_cooldown_s must be >= 0, got {breaker_cooldown_s}")
         self.max_size = max_size
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._breakers: dict = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -95,6 +139,76 @@ class EngineCache:
                 self._batched.popitem(last=False)
                 self.batched_evictions += 1
             return stepper, False
+
+    # -- circuit breaker ---------------------------------------------------
+
+    def record_failure(self, signature: tuple) -> bool:
+        """Count one engine failure against ``signature``; returns True
+        when the breaker is (now) open — i.e. the signature is
+        quarantined and the caller should degrade instead of retrying."""
+        with self._lock:
+            st = self._breakers.get(signature)
+            if st is None:
+                st = self._breakers[signature] = _Breaker()
+            st.failures += 1
+            if st.failures >= self.breaker_threshold:
+                if st.opened_at is None:
+                    st.trips += 1
+                # (re)opening refreshes the cooldown clock, so a failed
+                # half-open trial buys a full fresh cooldown
+                st.opened_at = time.monotonic()
+                return True
+            return st.opened_at is not None
+
+    def record_success(self, signature: tuple) -> None:
+        """A successful engine dispatch closes the breaker and zeroes the
+        consecutive-failure count (consecutive means consecutive)."""
+        with self._lock:
+            st = self._breakers.get(signature)
+            if st is not None:
+                st.failures = 0
+                st.opened_at = None
+
+    def breaker_state(self, signature: tuple) -> str:
+        """'closed' | 'open' | 'half_open' (open, cooldown elapsed — one
+        trial dispatch is admitted)."""
+        with self._lock:
+            return self._breaker_state_locked(signature)
+
+    def _breaker_state_locked(self, signature: tuple) -> str:
+        st = self._breakers.get(signature)
+        if st is None or st.opened_at is None:
+            return "closed"
+        if time.monotonic() - st.opened_at >= self.breaker_cooldown_s:
+            return "half_open"
+        return "open"
+
+    def breaker_allows(self, signature: tuple) -> bool:
+        """May the caller dispatch on this signature's engine?  True when
+        closed or half-open (the trial); False while open."""
+        return self.breaker_state(signature) != "open"
+
+    def breaker_stats(self) -> dict:
+        with self._lock:
+            open_, half = [], []
+            trips = failures = 0
+            for sig, st in self._breakers.items():
+                trips += st.trips
+                failures += st.failures
+                state = self._breaker_state_locked(sig)
+                if state == "open":
+                    open_.append(signature_label(sig))
+                elif state == "half_open":
+                    half.append(signature_label(sig))
+            return {
+                "threshold": self.breaker_threshold,
+                "cooldown_s": self.breaker_cooldown_s,
+                "tracked_signatures": len(self._breakers),
+                "trips": trips,
+                "consecutive_failures": failures,
+                "open": sorted(open_),
+                "half_open": sorted(half),
+            }
 
     def __len__(self) -> int:
         with self._lock:

@@ -7,11 +7,24 @@ Example::
     curl -X POST localhost:8000/sessions/s1/step -d '{"steps":10}'
     curl localhost:8000/sessions/s1/density
     curl localhost:8000/stats
+
+Fault tolerance (see README "Fault tolerance" for the full story)::
+
+    python -m mpi_tpu.cli serve --state-dir /var/lib/mpi_tpu \\
+        --request-timeout-s 30 --breaker-threshold 3
+
+``--state-dir`` makes sessions survive a ``kill -9`` (crash-safe JSON
+records + deterministic replay); ``--request-timeout-s`` bounds every
+verb; the breaker flags govern when a failing engine's plan is
+quarantined and its sessions degrade to the ``serial_np`` oracle.
+``--inject-faults`` (or the ``MPI_TPU_FAULTS`` env var) drives the
+recovery paths deterministically for testing.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -38,7 +51,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-batch", action="store_true",
                    help="disable microbatching; every step dispatches solo")
     p.add_argument("--verbose", action="store_true",
-                   help="log one line per HTTP request")
+                   help="log one line per HTTP request (with request ids)")
+    p.add_argument("--state-dir", default=None,
+                   help="persist session records here (crash-safe JSON); "
+                   "restart with the same dir to restore every board by "
+                   "deterministic replay, bit-identical")
+    p.add_argument("--checkpoint-every", type=int, default=64,
+                   help="generations between packed grid snapshots in the "
+                   "session record (bounds replay length on restore)")
+    p.add_argument("--request-timeout-s", type=float, default=30.0,
+                   help="time budget per request; a hung dispatch becomes "
+                   "a structured 503 with the session intact "
+                   "(0 disables; per-request override: ?timeout_s=)")
+    p.add_argument("--step-retries", type=int, default=2,
+                   help="retries (with exponential backoff) for a failed "
+                   "engine step before answering 503")
+    p.add_argument("--retry-backoff-ms", type=float, default=50.0,
+                   help="initial retry backoff, doubling per attempt")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive engine failures that open a plan "
+                   "signature's circuit breaker")
+    p.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                   help="open-breaker cooldown before one half-open trial "
+                   "dispatch is admitted")
+    p.add_argument("--no-degrade", action="store_true",
+                   help="do NOT fall back to the serial_np oracle when a "
+                   "breaker opens; affected requests answer 503 instead")
+    p.add_argument("--inject-faults", default=None, metavar="SPEC",
+                   help="deterministic fault plan at the engine dispatch "
+                   "boundary, e.g. 'step:3:raise' or 'any:2:hang:5' "
+                   "(testing; env fallback MPI_TPU_FAULTS)")
     return p
 
 
@@ -51,12 +93,22 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     from mpi_tpu.utils.platform import apply_platform_override
 
     apply_platform_override()
+    faults = args.inject_faults or os.environ.get("MPI_TPU_FAULTS") or None
     try:
         manager = SessionManager(
-            EngineCache(max_size=args.cache_size),
+            EngineCache(max_size=args.cache_size,
+                        breaker_threshold=args.breaker_threshold,
+                        breaker_cooldown_s=args.breaker_cooldown_s),
             batching=not args.no_batch,
             batch_window_ms=args.batch_window_ms,
             batch_max=args.batch_max,
+            state_dir=args.state_dir,
+            checkpoint_every=args.checkpoint_every,
+            request_timeout_s=args.request_timeout_s,
+            step_retries=args.step_retries,
+            retry_backoff_s=args.retry_backoff_ms / 1e3,
+            degrade=not args.no_degrade,
+            faults=faults,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -65,8 +117,16 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     host, port = server.server_address[:2]
     batch = ("off" if args.no_batch else
              f"window {args.batch_window_ms}ms max {args.batch_max}")
+    extras = []
+    if args.state_dir:
+        extras.append(f"state-dir {args.state_dir}")
+        if manager.restored_sessions:
+            extras.append(f"restored {manager.restored_sessions}")
+    if faults:
+        extras.append(f"faults '{faults}'")
+    extra = (", " + ", ".join(extras)) if extras else ""
     print(f"[mpi_tpu] serving on http://{host}:{port} "
-          f"(cache size {args.cache_size}, batch {batch})", flush=True)
+          f"(cache size {args.cache_size}, batch {batch}{extra})", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,208 @@
+"""Deterministic fault injection at the engine dispatch boundary.
+
+Every recovery path in the serve layer — retry with backoff, the
+circuit breaker, host-backend degradation, the dispatch watchdog —
+exists because a device dispatch can raise, hang, or stall.  None of
+those failures can be produced on demand by real hardware in a unit
+test, so this module fakes them *deterministically*: a
+:class:`FaultPlan` parsed from ``--inject-faults SPEC`` (or the
+``MPI_TPU_FAULTS`` env var) decides, purely from the dispatch ordinal,
+whether the Nth engine dispatch raises :class:`InjectedFault`, hangs
+(sleeps, then raises — the step must never half-commit), or delays
+(sleeps, then proceeds normally).
+
+The hook point is :meth:`mpi_tpu.backends.tpu.Engine.step` /
+``step_batched``: the serve layer installs
+:meth:`FaultInjector.engine_hook` on every engine it hands to a
+session, so faults fire exactly where a sick TPU would — after compile,
+before the device call, with the session's grid still intact.  (Real
+failures can also corrupt the donated input buffer; the degradation
+path never trusts the device grid for exactly that reason — it replays
+from the last checkpoint instead.)
+
+Spec grammar (comma-separated clauses; a leading ``seed=N`` clause
+seeds the probabilistic selector)::
+
+    SPEC   := [ 'seed=' int ',' ] clause ( ',' clause )*
+    clause := site ':' sel ':' mode [ ':' seconds ]
+    site   := 'step' | 'batched' | 'any'
+    sel    := N | N'+' | N'-'M | '*' | 'p'FLOAT
+    mode   := 'raise' | 'hang' | 'delay'
+
+``sel`` counts dispatches at that site from 1 (``any`` counts both
+sites together): ``3`` fires on exactly the 3rd dispatch, ``3+`` from
+the 3rd on, ``2-4`` on the 2nd through 4th, ``*`` on every one, and
+``p0.25`` on each with probability 0.25 drawn from a ``random.Random``
+seeded by the plan's ``seed=`` clause (default 0) — same seed, same
+dispatch order, same faults, every run.  ``seconds`` defaults to 30 for
+``hang`` and 0.05 for ``delay``; ``raise`` ignores it.
+
+Examples::
+
+    --inject-faults 'step:1-3:raise'       # first three solo dispatches fail
+    --inject-faults 'any:2:hang:5'         # 2nd dispatch wedges for 5 s
+    --inject-faults 'seed=7,step:p0.1:raise'
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from mpi_tpu.config import ConfigError
+
+_SITES = ("step", "batched", "any")
+_MODES = ("raise", "hang", "delay")
+_DEFAULT_SECONDS = {"raise": 0.0, "hang": 30.0, "delay": 0.05}
+
+
+class InjectedFault(RuntimeError):
+    """The error a 'raise' (or an ended 'hang') fault throws — a stand-in
+    for whatever a sick device dispatch would have raised."""
+
+
+@dataclass(frozen=True)
+class _Clause:
+    site: str                       # step | batched | any
+    lo: Optional[int]               # 1-based dispatch range [lo, hi]
+    hi: Optional[int]               # None with lo=None means probabilistic
+    prob: Optional[float]
+    mode: str                       # raise | hang | delay
+    seconds: float
+
+    def matches(self, nth: int, draw: Optional[float]) -> bool:
+        if self.prob is not None:
+            return draw is not None and draw < self.prob
+        if self.lo is None:
+            return True                             # '*'
+        return self.lo <= nth <= (self.hi if self.hi is not None else nth)
+
+
+class FaultPlan:
+    """Parsed, immutable fault spec; :class:`FaultInjector` executes it."""
+
+    def __init__(self, clauses: List[_Clause], seed: int = 0,
+                 spec: str = ""):
+        self.clauses = tuple(clauses)
+        self.seed = seed
+        self.spec = spec
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        clauses, seed = [], 0
+        for raw in str(spec).split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if raw.startswith("seed="):
+                try:
+                    seed = int(raw[5:])
+                except ValueError:
+                    raise ConfigError(f"bad fault seed clause {raw!r}")
+                continue
+            parts = raw.split(":")
+            if len(parts) not in (3, 4):
+                raise ConfigError(
+                    f"bad fault clause {raw!r}; want site:sel:mode[:seconds]")
+            site, sel, mode = parts[0], parts[1], parts[2]
+            if site not in _SITES:
+                raise ConfigError(
+                    f"bad fault site {site!r}; one of {_SITES}")
+            if mode not in _MODES:
+                raise ConfigError(
+                    f"bad fault mode {mode!r}; one of {_MODES}")
+            lo = hi = prob = None
+            try:
+                if sel == "*":
+                    pass
+                elif sel.startswith("p"):
+                    prob = float(sel[1:])
+                    if not 0.0 <= prob <= 1.0:
+                        raise ValueError
+                elif sel.endswith("+"):
+                    lo, hi = int(sel[:-1]), None
+                elif "-" in sel:
+                    a, b = sel.split("-")
+                    lo, hi = int(a), int(b)
+                else:
+                    lo = hi = int(sel)
+                if lo is not None and lo < 1:
+                    raise ValueError
+            except ValueError:
+                raise ConfigError(
+                    f"bad fault selector {sel!r}; want N, N+, N-M, *, or pF")
+            try:
+                seconds = (float(parts[3]) if len(parts) == 4
+                           else _DEFAULT_SECONDS[mode])
+            except ValueError:
+                raise ConfigError(f"bad fault seconds in {raw!r}")
+            if seconds < 0:
+                raise ConfigError(f"fault seconds must be >= 0 in {raw!r}")
+            clauses.append(_Clause(site, lo, hi, prob, mode, seconds))
+        if not clauses:
+            raise ConfigError(f"fault spec {spec!r} has no clauses")
+        return cls(clauses, seed=seed, spec=str(spec))
+
+
+class FaultInjector:
+    """Executes a :class:`FaultPlan` against the live dispatch stream.
+
+    Thread-safe: the counter/RNG advance under a lock, the sleep and the
+    raise happen outside it (a hanging fault must wedge only its own
+    dispatch, not the injector).  One injector serves every engine in
+    the process — the serve layer installs :meth:`engine_hook` as
+    ``Engine.fault_hook`` on each engine it creates or reuses."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._counts = {"step": 0, "batched": 0, "any": 0}
+        self._rng = random.Random(plan.seed)
+        self.injected = {"raise": 0, "hang": 0, "delay": 0}
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultInjector":
+        return cls(FaultPlan.parse(spec))
+
+    def engine_hook(self, site: str) -> None:
+        """Called by the engine immediately before a device dispatch;
+        ``site`` is 'step' or 'batched'.  Raises :class:`InjectedFault`
+        (raise/hang modes) or returns after an optional delay."""
+        action: Optional[Tuple[str, float, str]] = None
+        with self._lock:
+            self._counts[site] += 1
+            self._counts["any"] += 1
+            for c in self.plan.clauses:
+                if c.site not in (site, "any"):
+                    continue
+                nth = self._counts[c.site if c.site != "any" else "any"]
+                draw = self._rng.random() if c.prob is not None else None
+                if c.matches(nth, draw):
+                    action = (c.mode, c.seconds,
+                              f"injected {c.mode} at {site} dispatch "
+                              f"#{self._counts[site]}")
+                    self.injected[c.mode] += 1
+                    break
+        if action is None:
+            return
+        mode, seconds, msg = action
+        if mode == "delay":
+            time.sleep(seconds)
+            return
+        if mode == "hang":
+            # sleep out the hang, then FAIL: the dispatch must never
+            # half-commit a step the client was already told timed out
+            time.sleep(seconds)
+        raise InjectedFault(msg)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "spec": self.plan.spec,
+                "seed": self.plan.seed,
+                "dispatches": dict(self._counts),
+                "injected": dict(self.injected),
+            }

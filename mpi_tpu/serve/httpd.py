@@ -13,21 +13,42 @@ Routes (all bodies and responses are JSON):
                                        (the ``batch`` section, when enabled)
 
 Errors: 400 with {"error": ...} for bad specs/bodies (``ConfigError``/
-``ValueError``), 404 for unknown sessions and routes.  The server is a
-``ThreadingHTTPServer`` — requests against different boards run
-concurrently; the per-session locks in ``session.py`` serialize requests
-against the same board, and concurrent same-signature step requests are
-coalesced into stacked batched dispatches by ``serve/batch.py``.
+``ValueError``), 404 for unknown sessions and routes, 503 for fault-
+tolerance outcomes (deadline exceeded, breaker open with degradation
+disabled, retries exhausted — the session survives all three), and a
+catch-all 500 with ``{"error": ..., "request_id": N}`` for anything
+unexpected: a bug must answer structured JSON on a live connection,
+never ``http.server``'s stock HTML traceback page.  Every request gets
+a server-unique id; verbose mode logs it with the outcome line and the
+500 path prints the traceback to stderr under the same id, so a client
+report ("request 1041 gave me a 500") lines up with the server log.
+
+Per-request deadline override: ``?timeout_s=SECONDS`` on any session
+verb (or a ``timeout_s`` body key on step/create) overrides the
+server-wide ``--request-timeout-s``; ``timeout_s=0`` disables the
+budget for that request.
+
+The server is a ``ThreadingHTTPServer`` — requests against different
+boards run concurrently; the per-session locks in ``session.py``
+serialize requests against the same board, and concurrent
+same-signature step requests are coalesced into stacked batched
+dispatches by ``serve/batch.py``.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import sys
+import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 from mpi_tpu.config import ConfigError
-from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.serve.session import (
+    DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
+)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -48,6 +69,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+        if getattr(self.server, "verbose", False):
+            print(f"[mpi_tpu] request {getattr(self, '_rid', '?')}: "
+                  f"{self.command} {self.path} -> {code}", file=sys.stderr)
 
     def _body(self) -> dict:
         n = int(self.headers.get("Content-Length") or 0)
@@ -60,6 +84,21 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(data, dict):
             raise ConfigError("request body must be a JSON object")
         return data
+
+    def _timeout_override(self, body: dict) -> Optional[float]:
+        """The request's explicit deadline override, or None to use the
+        server default: ``?timeout_s=`` wins over a ``timeout_s`` body
+        key.  (It is a transport parameter, not part of the board spec —
+        the create body's strict key check never sees it.)"""
+        qs = parse_qs(urlsplit(self.path).query)
+        raw = qs["timeout_s"][0] if "timeout_s" in qs else body.pop(
+            "timeout_s", None)
+        if raw is None:
+            return None
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(f"timeout_s must be a number, got {raw!r}")
 
     def _route(self) -> Tuple[str, Optional[str], Optional[str]]:
         """(kind, session_id, verb) from the path."""
@@ -79,31 +118,57 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str) -> None:
         mgr: SessionManager = self.server.manager
+        rid = next(self.server.request_ids)
+        self._rid = rid                     # _reply's verbose outcome line
         kind, sid, verb = self._route()
         try:
             if kind == "healthz" and method == "GET":
-                return self._reply(200, {"ok": True, "sessions": len(mgr)})
+                health = mgr.health()
+                return self._reply(200 if health["ok"] else 503, health)
             if kind == "stats" and method == "GET":
                 return self._reply(200, mgr.stats())
             if kind == "sessions" and method == "POST":
-                return self._reply(200, mgr.create(self._body()))
+                body = self._body()
+                timeout_s = self._timeout_override(body)
+                return self._reply(200, mgr.create(body, timeout_s=timeout_s))
             if kind == "session" and sid is not None:
                 if method == "POST" and verb == "step":
-                    steps = self._body().get("steps", 1)
+                    body = self._body()
+                    timeout_s = self._timeout_override(body)
+                    steps = body.get("steps", 1)
                     if not isinstance(steps, int):
                         raise ConfigError(f"steps must be an int, got {steps!r}")
-                    return self._reply(200, mgr.step(sid, steps))
+                    return self._reply(
+                        200, mgr.step(sid, steps, timeout_s=timeout_s))
                 if method == "GET" and verb == "snapshot":
-                    return self._reply(200, mgr.snapshot(sid))
+                    return self._reply(200, mgr.snapshot(
+                        sid, timeout_s=self._timeout_override({})))
                 if method == "GET" and verb == "density":
-                    return self._reply(200, mgr.density(sid))
+                    return self._reply(200, mgr.density(
+                        sid, timeout_s=self._timeout_override({})))
                 if method == "DELETE" and verb is None:
-                    return self._reply(200, mgr.close(sid))
+                    return self._reply(200, mgr.close(
+                        sid, timeout_s=self._timeout_override({})))
             return self._reply(404, {"error": f"no route {method} {self.path}"})
         except KeyError:
             return self._reply(404, {"error": f"no session {sid!r}"})
+        except (DeadlineError, EngineUnavailableError, EngineStepError) as e:
+            # fault-tolerance outcomes: the session survives; 503 tells
+            # the client "try again / try later", never "you sent garbage"
+            return self._reply(503, {"error": str(e), "request_id": rid})
         except (ConfigError, ValueError) as e:
             return self._reply(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the structured-500 backstop
+            # without this, http.server answers an HTML traceback page and
+            # drops the connection; a JSON API must fail in JSON.  The
+            # traceback goes to stderr under the request id, not the wire.
+            print(f"[mpi_tpu] request {rid}: unhandled "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            return self._reply(500, {
+                "error": f"internal server error ({type(e).__name__})",
+                "request_id": rid,
+            })
 
     # -- verbs -------------------------------------------------------------
 
@@ -126,4 +191,5 @@ def make_server(host: str = "127.0.0.1", port: int = 0,
     server = ThreadingHTTPServer((host, port), _Handler)
     server.manager = manager if manager is not None else SessionManager()
     server.verbose = verbose
+    server.request_ids = itertools.count(1)
     return server

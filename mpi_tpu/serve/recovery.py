@@ -1,0 +1,184 @@
+"""Crash-safe session persistence — the serve layer's checkpoint/restore.
+
+A ``kill -9`` of ``mpi_tpu serve`` must not lose live boards.  The
+paper's design makes that cheap: stepping is deterministic from
+``(spec, seed)`` and every engine is bit-identical to the ``serial_np``
+oracle (PARITY.md), so a session is fully described by its *spec*, its
+*generation*, and (as an optimization bounding replay length) an
+occasional packed grid snapshot.  This module persists exactly that:
+one JSON record per session under ``--state-dir``, rewritten on every
+committed step via write-to-temp + ``os.replace`` (atomic on POSIX — a
+crash mid-write leaves the previous complete record, never a torn one).
+
+The grid snapshot rides in the record every ``checkpoint_every``
+generations as base64 of ``np.packbits`` (1 bit/cell, ~8 KB for a
+256x256 board).  On restart, :meth:`SessionManager._restore_all
+<mpi_tpu.serve.session.SessionManager>` rebuilds each session from the
+snapshot (or the seed) and replays the remaining generations through
+its own backend — restored boards are bit-identical to an uninterrupted
+run, which ``tests/test_serve_recovery.py`` asserts for both the
+TPU-path engine and host backends.
+
+What does NOT persist (by design): compiled engines (rebuilt lazily on
+the first touch, softened by the persistent XLA cache), breaker state
+and counters (a restart is the escape hatch a breaker exists to
+approximate), and any in-flight step (the client saw an error or a dead
+connection, never a commit).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+RECORD_VERSION = 1
+
+
+def encode_grid(grid: np.ndarray) -> dict:
+    """A JSON-safe packed snapshot of a 0/1 uint8 grid."""
+    arr = np.asarray(grid, dtype=np.uint8)
+    rows, cols = arr.shape
+    packed = np.packbits(arr, axis=None)
+    return {
+        "rows": int(rows),
+        "cols": int(cols),
+        "packed": base64.b64encode(packed.tobytes()).decode("ascii"),
+    }
+
+
+def decode_grid(snap: dict) -> np.ndarray:
+    rows, cols = int(snap["rows"]), int(snap["cols"])
+    raw = np.frombuffer(base64.b64decode(snap["packed"]), dtype=np.uint8)
+    bits = np.unpackbits(raw, count=rows * cols)
+    return bits.reshape(rows, cols).astype(np.uint8)
+
+
+class StateStore:
+    """One JSON record per session under ``state_dir``.
+
+    Record shape::
+
+        {"v": 1, "id": "s3", "spec": {...create body...},
+         "generation": 41,
+         "snapshot": {"generation": 32, "rows": ..., "cols": ...,
+                      "packed": "<base64 np.packbits>"} | null}
+
+    ``save`` is called with the owning session's lock held (generation
+    and snapshot must leave the lock together — the same torn-read
+    discipline as the live snapshot verb), so the store's own lock only
+    guards its counters and the shared tmp-name sequence.
+    """
+
+    def __init__(self, state_dir: str, checkpoint_every: int = 64):
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        self.state_dir = state_dir
+        self.checkpoint_every = int(checkpoint_every)
+        os.makedirs(state_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._tmp_seq = 0
+        self.writes = 0
+        self.snapshot_writes = 0
+        self.deletes = 0
+        self.load_errors = 0
+
+    # -- paths -------------------------------------------------------------
+
+    def _path(self, sid: str) -> str:
+        # session ids are manager-generated ("s<N>") — no traversal risk,
+        # but keep the guard so a hand-edited state dir cannot escape
+        safe = "".join(ch for ch in sid if ch.isalnum() or ch in "-_")
+        return os.path.join(self.state_dir, f"{safe}.json")
+
+    # -- write path --------------------------------------------------------
+
+    def save(self, sid: str, spec: dict, generation: int,
+             snapshot: Optional[dict]) -> None:
+        """Atomically (re)write the record for ``sid``.  ``snapshot`` is
+        the encoded grid dict plus its ``generation`` key, or None (replay
+        will start from the seed)."""
+        rec = {
+            "v": RECORD_VERSION,
+            "id": sid,
+            "spec": spec,
+            "generation": int(generation),
+            "snapshot": snapshot,
+        }
+        path = self._path(sid)
+        with self._lock:
+            self._tmp_seq += 1
+            tmp = f"{path}.tmp{self._tmp_seq}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with self._lock:
+            self.writes += 1
+            if snapshot is not None:
+                self.snapshot_writes += 1
+
+    def delete(self, sid: str) -> None:
+        try:
+            os.remove(self._path(sid))
+        except FileNotFoundError:
+            pass
+        with self._lock:
+            self.deletes += 1
+
+    # -- read path ---------------------------------------------------------
+
+    def load_records(self) -> List[Dict]:
+        """Every parseable record, ordered by numeric session id (so
+        restored ids and the id counter line up deterministically).
+        Corrupt or alien files are skipped and counted (``load_errors``)
+        — a recovery pass must salvage what it can, not die on the one
+        record a crash mangled."""
+        out = []
+        try:
+            names = sorted(os.listdir(self.state_dir))
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.state_dir, name)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                if (not isinstance(rec, dict)
+                        or rec.get("v") != RECORD_VERSION
+                        or not isinstance(rec.get("id"), str)
+                        or not isinstance(rec.get("spec"), dict)
+                        or not isinstance(rec.get("generation"), int)):
+                    raise ValueError(f"malformed session record {name}")
+                out.append(rec)
+            except (OSError, ValueError, json.JSONDecodeError):
+                with self._lock:
+                    self.load_errors += 1
+        out.sort(key=lambda r: _sid_ordinal(r["id"]))
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state_dir": self.state_dir,
+                "checkpoint_every": self.checkpoint_every,
+                "writes": self.writes,
+                "snapshot_writes": self.snapshot_writes,
+                "deletes": self.deletes,
+                "load_errors": self.load_errors,
+            }
+
+
+def _sid_ordinal(sid: str) -> int:
+    try:
+        return int(sid.lstrip("s"))
+    except ValueError:
+        return 1 << 30

@@ -20,25 +20,68 @@ coalesce into one stacked ``Engine.step_batched`` dispatch — B boards
 pay ONE ~68 ms tunnel dispatch instead of B (PERF.md) — while lone
 requests, host backends, and any batched-path failure take the solo
 path, so batching only ever removes dispatches, never changes results.
+
+Fault tolerance (PR 3) wraps the whole step path:
+
+* **Deadlines** — every verb accepts a time budget
+  (``request_timeout_s`` default, per-request override); engine
+  dispatches run inside a *watchdog* worker thread, so a hung
+  ``block_until_ready`` becomes a :class:`DeadlineError` (HTTP 503)
+  while the HTTP handler thread walks free.  The wedged worker holds the
+  session lock until the device call ends; every later request against
+  that board times out cleanly instead of piling up.
+* **Retry + circuit breaker** — transient engine failures retry with
+  bounded exponential backoff inside the request's budget; consecutive
+  failures are counted per plan signature in the
+  :class:`~mpi_tpu.serve.cache.EngineCache` breaker, and once it opens
+  the affected sessions *degrade*: their board is rebuilt by
+  deterministic replay (seed or last checkpoint → ``serial_np`` oracle,
+  bit-identical by PARITY.md) and served by the host stepper.  Results
+  stay exact; only throughput degrades.  With degradation disabled an
+  open breaker answers :class:`EngineUnavailableError` (HTTP 503).
+* **Checkpoint/restore** — with a ``state_dir``, every committed step
+  persists the session record (crash-safe, ``serve/recovery.py``) and a
+  packed grid snapshot every ``checkpoint_every`` generations; a new
+  manager over the same dir rebuilds every session by replay,
+  bit-identical to an uninterrupted run.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from mpi_tpu.backends.serial_np import evolve_np
 from mpi_tpu.config import ConfigError, GolConfig, plan_signature
 from mpi_tpu.models.rules import rule_from_name
+from mpi_tpu.serve import recovery
 from mpi_tpu.serve.batch import MicroBatcher
 from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.utils.hashinit import init_tile_np
 
 _SPEC_KEYS = {
     "rows", "cols", "rule", "boundary", "backend", "seed", "comm_every",
     "overlap", "mesh", "segments",
 }
+
+
+class DeadlineError(RuntimeError):
+    """The request's time budget ran out (a slow or hung dispatch, or a
+    board wedged behind one).  Maps to HTTP 503; the session survives."""
+
+
+class EngineUnavailableError(RuntimeError):
+    """The plan signature's circuit breaker is open and degradation is
+    disabled — there is nothing left to serve the request with (503)."""
+
+
+class EngineStepError(RuntimeError):
+    """An engine step failed and retries were exhausted without tripping
+    the breaker (503; the client may retry — the breaker is counting)."""
 
 
 def _parse_spec(spec: dict):
@@ -90,6 +133,58 @@ def _parse_spec(spec: dict):
     return config, segments
 
 
+class _Deadline:
+    """A monotonic countdown; ``seconds=None`` never expires."""
+
+    __slots__ = ("t0", "seconds")
+
+    def __init__(self, seconds: Optional[float]):
+        self.t0 = time.monotonic()
+        self.seconds = None if seconds is None else max(0.0, float(seconds))
+
+    def remaining(self) -> Optional[float]:
+        if self.seconds is None:
+            return None
+        return max(0.0, self.seconds - (time.monotonic() - self.t0))
+
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0
+
+
+def _watchdog_call(fn, deadline: _Deadline, label: str):
+    """Run ``fn`` under the dispatch watchdog: with no budget it runs
+    inline (zero overhead, the pre-PR-3 path); with one, it runs in a
+    daemon worker thread and a timeout raises :class:`DeadlineError` in
+    the caller while the worker is *abandoned* — Python threads cannot
+    be killed, but an abandoned worker merely finishes (or wedges) in
+    the background holding the session lock, which later requests see as
+    their own clean deadline timeouts rather than a stuck handler."""
+    budget = deadline.remaining()
+    if budget is None:
+        return fn()
+    box = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name=f"watchdog:{label}")
+    t.start()
+    if not done.wait(budget):
+        raise DeadlineError(
+            f"{label} exceeded its {deadline.seconds:.3g}s budget "
+            f"(dispatch abandoned to the watchdog; the session survives)")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
 class Session:
     """One live board.  ``engine`` is set for tpu sessions (grid is a
     device array); host backends keep a numpy grid and a ``stepper(grid,
@@ -113,6 +208,13 @@ class Session:
         self.steady_s = 0.0             # needs a new depth); stepping time
         self.lock = threading.Lock()
         self.closed = False
+        # fault-tolerance state
+        self.spec: Optional[dict] = None    # normalized create body (persistence)
+        self.ckpt: Optional[dict] = None    # last encoded grid snapshot
+        self.degraded = False               # serving via serial_np fallback
+        self.degraded_reason: Optional[str] = None
+        self.restored = False               # rebuilt by replay after restart
+        self.last_error: Optional[str] = None
 
     def throughput(self) -> dict:
         gens = self.generation
@@ -128,7 +230,10 @@ class Session:
 
 
 class SessionManager:
-    """Owns the session table, the engine cache, and the microbatcher.
+    """Owns the session table, the engine cache, the microbatcher, and
+    (PR 3) the fault-tolerance machinery: the state store, the fault
+    injector, the per-signature breakers (in the cache), and the
+    degradation path.
 
     Single-host by design (multi-host serving is a ROADMAP open item):
     snapshot/density fetch through ``Engine.fetch``/``population``, which
@@ -141,7 +246,14 @@ class SessionManager:
 
     def __init__(self, cache: Optional[EngineCache] = None, *,
                  batching: bool = True, batch_window_ms: float = 2.0,
-                 batch_max: int = 8):
+                 batch_max: int = 8,
+                 state_dir: Optional[str] = None,
+                 checkpoint_every: int = 64,
+                 request_timeout_s: Optional[float] = None,
+                 step_retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 degrade: bool = True,
+                 faults=None):
         self.cache = cache if cache is not None else EngineCache()
         self.batcher = (
             MicroBatcher(window_ms=batch_window_ms, max_batch=batch_max)
@@ -150,10 +262,45 @@ class SessionManager:
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._next = 0
+        # fault tolerance
+        if request_timeout_s is not None and request_timeout_s <= 0:
+            request_timeout_s = None            # 0 disables the budget
+        self.request_timeout_s = request_timeout_s
+        if step_retries < 0:
+            raise ValueError(f"step_retries must be >= 0, got {step_retries}")
+        self.step_retries = int(step_retries)
+        self.retry_backoff_s = max(0.0, float(retry_backoff_s))
+        self.degrade = bool(degrade)
+        if isinstance(faults, str):
+            from mpi_tpu.serve.faults import FaultInjector
+
+            faults = FaultInjector.from_spec(faults)
+        self.faults = faults
+        self.store = (recovery.StateStore(state_dir, checkpoint_every)
+                      if state_dir else None)
+        self.engine_failures = 0
+        self.watchdog_timeouts = 0
+        self.degraded_total = 0
+        self.restored_sessions = 0
+        self.restore_errors = 0
+        self.store_errors = 0
+        self._last_dispatch_ok: Optional[float] = None
+        if self.store is not None:
+            self._restore_all()
 
     # -- lifecycle ---------------------------------------------------------
 
-    def create(self, spec: dict) -> dict:
+    def create(self, spec: dict, timeout_s: Optional[float] = None) -> dict:
+        """Create a board.  ``timeout_s`` (explicit only — the default
+        budget deliberately does NOT cover create: a cold create
+        legitimately spends many seconds in XLA, and an abandoned create
+        worker would still register its session) bounds the build."""
+        if timeout_s is not None and timeout_s <= 0:
+            timeout_s = None            # 0 disables, same as everywhere else
+        deadline = _Deadline(timeout_s)
+        return _watchdog_call(lambda: self._create(spec), deadline, "create")
+
+    def _create(self, spec: dict) -> dict:
         config, segments = _parse_spec(spec)
         t0 = time.perf_counter()
         if config.backend == "tpu":
@@ -161,23 +308,38 @@ class SessionManager:
         else:
             session = self._create_host(config)
         session.setup_s = time.perf_counter() - t0
+        session.spec = dict(spec)
         with self._lock:
             self._next += 1
             session.id = f"s{self._next}"
             self._sessions[session.id] = session
+        self._persist(session)
         info = self.describe(session)
         info["cache"] = self.cache.stats()
         return info
 
-    def _create_tpu(self, config: GolConfig, segments) -> Session:
+    def _create_tpu(self, config: GolConfig, segments,
+                    initial=None) -> Session:
         from mpi_tpu.backends.tpu import build_engine, device_count
         from mpi_tpu.parallel.mesh import choose_mesh_shape, make_mesh
 
         mesh_shape = config.mesh_shape or choose_mesh_shape(device_count())
         sig = plan_signature(config, mesh_shape, segments)
+        if not self.cache.breaker_allows(sig):
+            # quarantined plan: never hand a fresh board to a sick engine
+            if not self.degrade:
+                raise EngineUnavailableError(
+                    "engine circuit breaker open for this plan signature "
+                    "and degradation is disabled")
+            session = self._degraded_host_session(config, initial=initial)
+            session.plan_sig = sig
+            return session
         engine, hit = self.cache.get_or_build(
             sig, lambda: build_engine(config, mesh=make_mesh(mesh_shape)))
-        grid = engine.init_grid(seed=config.seed)
+        if self.faults is not None:
+            # idempotent: cached engines get the same hook re-installed
+            engine.fault_hook = self.faults.engine_hook
+        grid = engine.init_grid(initial=initial, seed=config.seed)
         # precompile the requested segment set (a no-op on a cache hit —
         # the signature pins the set, so the hit engine already has it)
         engine.compile_segments(grid, segments)
@@ -185,12 +347,8 @@ class SessionManager:
                        plan_sig=sig)
 
     def _create_host(self, config: GolConfig) -> Session:
-        from mpi_tpu.utils.hashinit import init_tile_np
-
         rule, boundary = config.rule, config.boundary
         if config.backend == "serial":
-            from mpi_tpu.backends.serial_np import evolve_np
-
             def stepper(g, n):
                 return evolve_np(g, n, rule, boundary)
         elif config.backend == "cpp":
@@ -215,7 +373,30 @@ class SessionManager:
         grid = init_tile_np(config.rows, config.cols, config.seed)
         return Session("?", config, stepper=stepper, grid=grid)
 
-    def close(self, sid: str) -> dict:
+    def _degraded_host_session(self, config: GolConfig, initial=None,
+                               reason: str = "circuit breaker open at create",
+                               ) -> Session:
+        """A session born degraded: the oracle stepper over a numpy grid
+        (bit-identical to the engine it stands in for)."""
+        rule, boundary = config.rule, config.boundary
+
+        def stepper(g, n):
+            return evolve_np(g, n, rule, boundary)
+
+        grid = (np.asarray(initial, dtype=np.uint8) if initial is not None
+                else init_tile_np(config.rows, config.cols, config.seed))
+        session = Session("?", config, stepper=stepper, grid=grid)
+        session.degraded = True
+        session.degraded_reason = reason
+        self.degraded_total += 1
+        return session
+
+    def close(self, sid: str, timeout_s: Optional[float] = None) -> dict:
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(lambda: self._close(sid), deadline,
+                              f"close({sid})")
+
+    def _close(self, sid: str) -> dict:
         with self._lock:
             session = self._sessions.pop(sid, None)
         if session is None:
@@ -224,6 +405,8 @@ class SessionManager:
             session.closed = True
             session.grid = None         # free device/host buffers now; the
             session.engine = None       # cached engine survives for reuse
+        if self.store is not None:
+            self.store.delete(sid)
         return {"id": sid, "closed": True}
 
     def get(self, sid: str) -> Session:
@@ -233,12 +416,244 @@ class SessionManager:
             raise KeyError(sid)
         return session
 
+    # -- checkpoint / restore ---------------------------------------------
+
+    def _persist(self, session: Session, grid_np=None) -> None:
+        """Write the session's durable record (caller holds the session
+        lock on the step path; create/restore call it pre-publication).
+        ``grid_np``: a freshly fetched host grid to snapshot, or None to
+        keep the previous snapshot.  Store failures are counted, noted,
+        and swallowed — durability must degrade, not take the step down
+        with it."""
+        if self.store is None or session.spec is None:
+            return
+        try:
+            if grid_np is not None:
+                snap = recovery.encode_grid(grid_np)
+                snap["generation"] = session.generation
+                session.ckpt = snap
+            self.store.save(session.id, session.spec, session.generation,
+                            session.ckpt)
+        except Exception as e:  # noqa: BLE001 — durability is best-effort
+            self.store_errors += 1
+            print(f"note: state-dir write failed for {session.id}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    def _checkpoint(self, session: Session) -> None:
+        """Persist a committed step (caller holds ``session.lock``).  The
+        generation is recorded every step; the packed grid snapshot only
+        every ``checkpoint_every`` generations (fetching the device grid
+        is a sync)."""
+        if self.store is None:
+            return
+        grid_np = None
+        last = session.ckpt["generation"] if session.ckpt else 0
+        if session.generation - last >= self.store.checkpoint_every:
+            try:
+                if session.engine is not None:
+                    grid_np = session.engine.fetch(session.grid)
+                else:
+                    grid_np = np.asarray(session.grid, dtype=np.uint8)
+            except Exception as e:  # noqa: BLE001 — snapshot is an optimization
+                self.store_errors += 1
+                print(f"note: checkpoint fetch failed for {session.id}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                grid_np = None
+        self._persist(session, grid_np)
+
+    def _restore_all(self) -> None:
+        for rec in self.store.load_records():
+            try:
+                self._restore_one(rec)
+            except Exception as e:  # noqa: BLE001 — salvage the rest
+                self.restore_errors += 1
+                print(f"note: could not restore session "
+                      f"{rec.get('id')!r}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        if self.restored_sessions:
+            print(f"[mpi_tpu] restored {self.restored_sessions} session(s) "
+                  f"from {self.store.state_dir}", file=sys.stderr)
+
+    def _restore_one(self, rec: dict) -> None:
+        config, segments = _parse_spec(rec["spec"])
+        target_gen = int(rec["generation"])
+        snap = rec.get("snapshot")
+        initial = recovery.decode_grid(snap) if snap else None
+        start_gen = int(snap["generation"]) if snap else 0
+        if not 0 <= start_gen <= target_gen:
+            raise ValueError(
+                f"snapshot generation {start_gen} outside 0..{target_gen}")
+        t0 = time.perf_counter()
+        if config.backend == "tpu":
+            session = self._create_tpu(config, segments, initial=initial)
+        else:
+            session = self._create_host(config)
+            if initial is not None:
+                session.grid = initial
+        session.generation = start_gen
+        # deterministic replay to the recorded generation: stepping is a
+        # pure function of (grid, n) and every backend is bit-identical
+        # to the oracle (PARITY.md), so the restored board equals an
+        # uninterrupted run.  Engine replay goes in depth-1 chunks — the
+        # one depth every session precompiles — so restore costs
+        # dispatches, never fresh XLA programs.
+        n = target_gen - start_gen
+        if n > 0:
+            if session.engine is not None:
+                import jax
+
+                session.engine.ensure_compiled(session.grid, 1)
+                for _ in range(n):
+                    session.grid = session.engine.step(session.grid, 1)
+                jax.block_until_ready(session.grid)
+            else:
+                session.grid = session.stepper(session.grid, n)
+            session.generation = target_gen
+        session.setup_s = time.perf_counter() - t0
+        session.spec = dict(rec["spec"])
+        session.ckpt = snap
+        session.restored = True
+        sid = rec["id"]
+        with self._lock:
+            session.id = sid
+            self._sessions[sid] = session
+            self._next = max(self._next, recovery._sid_ordinal(sid))
+        self.restored_sessions += 1
+        self._persist(session)
+
+    # -- fault handling ----------------------------------------------------
+
+    def _budget(self, timeout_s: Optional[float]) -> Optional[float]:
+        if timeout_s is not None:
+            return None if timeout_s <= 0 else timeout_s
+        return self.request_timeout_s
+
+    def _engine_failure(self, session: Session, sig, err,
+                        timeout: bool = False) -> bool:
+        """Count one engine failure; returns True when the signature's
+        breaker is now open (caller should degrade, not retry)."""
+        self.engine_failures += 1
+        if timeout:
+            self.watchdog_timeouts += 1
+        session.last_error = f"{type(err).__name__}: {err}"
+        opened = self.cache.record_failure(sig)
+        if opened:
+            print(f"note: circuit breaker OPEN for plan of session "
+                  f"{session.id} after consecutive engine failures "
+                  f"(last: {session.last_error})", file=sys.stderr)
+        return opened
+
+    def _degrade_session(self, session: Session, reason: str) -> None:
+        """Swap ``session`` for a serial_np replacement rebuilt by
+        deterministic replay at the last *committed* generation.
+
+        Deliberately does NOT take ``session.lock``: the usual trigger is
+        a wedged dispatch still holding it.  The replacement is built
+        from the durable facts (spec/seed/checkpoint + committed
+        generation — plain attribute reads, atomic under the GIL), the
+        table entry is swapped under the manager lock, and the old object
+        is orphaned: a late-completing worker commits into the orphan,
+        which no request can reach anymore."""
+        with self._lock:
+            if self._sessions.get(session.id) is not session:
+                return                  # someone else already swapped it
+        grid = self._replay_np(session.config, session.generation,
+                               session.ckpt)
+        repl = self._degraded_host_session(session.config, initial=grid,
+                                           reason=reason)
+        repl.generation = session.generation
+        repl.plan_sig = session.plan_sig
+        repl.spec = session.spec
+        repl.ckpt = session.ckpt
+        repl.restored = session.restored
+        repl.cache_hit = session.cache_hit
+        repl.setup_s = session.setup_s
+        repl.steady_s = session.steady_s
+        repl.batched_steps = session.batched_steps
+        repl.last_error = session.last_error
+        with self._lock:
+            if self._sessions.get(session.id) is not session:
+                return
+            repl.id = session.id
+            self._sessions[session.id] = repl
+        session.closed = True           # orphan: late workers see closed
+        print(f"note: session {repl.id} degraded to the serial_np oracle "
+              f"({reason}); results stay bit-identical, throughput drops",
+              file=sys.stderr)
+        self._persist(repl)
+
+    @staticmethod
+    def _replay_np(config: GolConfig, generation: int,
+                   ckpt: Optional[dict]) -> np.ndarray:
+        """The board at ``generation``, rebuilt on the host oracle from
+        the last checkpoint (or the seed).  Never touches the device —
+        a failing engine may have corrupted or donated its buffers."""
+        if ckpt is not None:
+            grid = recovery.decode_grid(ckpt)
+            start = int(ckpt["generation"])
+        else:
+            grid = init_tile_np(config.rows, config.cols, config.seed)
+            start = 0
+        return evolve_np(grid, generation - start, config.rule,
+                         config.boundary)
+
+    def _mark_dispatch_ok(self) -> None:
+        self._last_dispatch_ok = time.monotonic()
+
     # -- verbs -------------------------------------------------------------
 
-    def step(self, sid: str, steps: int = 1) -> dict:
+    def step(self, sid: str, steps: int = 1,
+             timeout_s: Optional[float] = None) -> dict:
         if steps < 1:
             raise ConfigError(f"steps must be >= 1, got {steps}")
-        session = self.get(sid)
+        deadline = _Deadline(self._budget(timeout_s))
+        attempt = 0
+        while True:
+            session = self.get(sid)
+            sig = session.plan_sig if session.engine is not None else None
+            if sig is not None and not self.cache.breaker_allows(sig):
+                if not self.degrade:
+                    raise EngineUnavailableError(
+                        f"engine circuit breaker open for session {sid} "
+                        f"and degradation is disabled")
+                self._degrade_session(session, "circuit breaker open")
+                continue                # re-get: now a host-path session
+            try:
+                result = _watchdog_call(
+                    lambda: self._step_entry(session, steps), deadline,
+                    f"step({sid})")
+            except (KeyError, ConfigError):
+                raise
+            except DeadlineError as e:
+                if sig is not None:
+                    self._engine_failure(session, sig, e, timeout=True)
+                raise                   # the budget is gone — no retry
+            except Exception as e:  # noqa: BLE001 — engine failures only
+                if sig is None:
+                    raise               # host failures are not retriable
+                opened = self._engine_failure(session, sig, e)
+                attempt += 1
+                if opened:
+                    continue            # loop top degrades (or 503s)
+                rem = deadline.remaining()
+                if attempt > self.step_retries or (rem is not None and rem <= 0):
+                    raise EngineStepError(
+                        f"engine step failed after {attempt} attempt(s): "
+                        f"{type(e).__name__}: {e}") from e
+                pause = self.retry_backoff_s * (2 ** (attempt - 1))
+                if rem is not None:
+                    pause = min(pause, rem)
+                if pause > 0:
+                    time.sleep(pause)
+                continue
+            if sig is not None:
+                self.cache.record_success(sig)
+            return result
+
+    def _step_entry(self, session: Session, steps: int) -> dict:
+        """One step attempt: the batched path when eligible, else solo
+        under the session lock.  Runs inside the watchdog worker when a
+        budget is set."""
         if self.batcher is not None and session.engine is not None \
                 and session.plan_sig is not None:
             # engine-backed steps coalesce: concurrent same-signature
@@ -248,13 +663,13 @@ class SessionManager:
             return self.batcher.submit(self, session, steps)
         with session.lock:
             if session.closed:
-                raise KeyError(sid)
+                raise KeyError(session.id)
             return self._step_locked(session, steps)
 
     def _step_locked(self, session: Session, steps: int) -> dict:
-        """The solo step body; caller holds ``session.lock`` (the HTTP
-        path via :meth:`step`, the microbatch leader for lone/fallback
-        entries)."""
+        """The solo step body; caller holds ``session.lock`` (the step
+        path via :meth:`_step_entry`, the microbatch leader for
+        lone/fallback entries)."""
         if session.engine is not None:
             import jax
 
@@ -270,15 +685,22 @@ class SessionManager:
             jax.block_until_ready(grid)
             session.grid = grid
             session.steady_s += time.perf_counter() - t1
+            self._mark_dispatch_ok()
         else:
             t0 = time.perf_counter()
             session.grid = session.stepper(session.grid, steps)
             session.steady_s += time.perf_counter() - t0
         session.generation += steps
+        self._checkpoint(session)
         return {"id": session.id, "generation": session.generation,
                 "steps": steps}
 
-    def snapshot(self, sid: str) -> dict:
+    def snapshot(self, sid: str, timeout_s: Optional[float] = None) -> dict:
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(lambda: self._snapshot(sid), deadline,
+                              f"snapshot({sid})")
+
+    def _snapshot(self, sid: str) -> dict:
         session = self.get(sid)
         with session.lock:
             if session.closed:
@@ -300,7 +722,12 @@ class SessionManager:
                 "rows": session.config.rows, "cols": session.config.cols,
                 "grid": rows}
 
-    def density(self, sid: str) -> dict:
+    def density(self, sid: str, timeout_s: Optional[float] = None) -> dict:
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(lambda: self._density(sid), deadline,
+                              f"density({sid})")
+
+    def _density(self, sid: str) -> dict:
         session = self.get(sid)
         with session.lock:
             if session.closed:
@@ -341,6 +768,14 @@ class SessionManager:
                 d["engine_batched_compiles"] = engine.batched_compile_count
                 d["engine_notes"] = list(engine.notes)
                 d["batched_steps"] = session.batched_steps
+            if session.degraded:
+                d["degraded"] = True
+                d["degraded_reason"] = session.degraded_reason
+                d["active_backend"] = "serial_np"
+            if session.restored:
+                d["restored"] = True
+            if session.last_error:
+                d["last_error"] = session.last_error
         return d
 
     def stats(self) -> dict:
@@ -352,7 +787,48 @@ class SessionManager:
         }
         if self.batcher is not None:
             out["batch"] = self.batcher.stats()
+        out["breaker"] = self.cache.breaker_stats()
+        out["failures"] = {
+            "engine_failures": self.engine_failures,
+            "watchdog_timeouts": self.watchdog_timeouts,
+            "degraded_sessions": sum(1 for s in sessions if s.degraded),
+            "degraded_total": self.degraded_total,
+            "degrade_fallback": self.degrade,
+        }
+        if self.store is not None:
+            rec = self.store.stats()
+            rec["restored_sessions"] = self.restored_sessions
+            rec["restore_errors"] = self.restore_errors
+            rec["store_errors"] = self.store_errors
+            out["recovery"] = rec
+        if self.faults is not None:
+            out["faults"] = self.faults.stats()
         return out
+
+    def health(self) -> dict:
+        """The deep ``/healthz`` payload.  ``ok`` is False — the probe
+        answers 503 — exactly when the service is degraded with no
+        fallback: some breaker is open and degradation is disabled, so
+        requests on those plans cannot be served at all."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+        br = self.cache.breaker_stats()
+        ok = not (br["open"] and not self.degrade)
+        age = (round(time.monotonic() - self._last_dispatch_ok, 3)
+               if self._last_dispatch_ok is not None else None)
+        return {
+            "ok": ok,
+            "sessions": len(sessions),
+            "degraded_sessions": sum(1 for s in sessions if s.degraded),
+            "restored_sessions": self.restored_sessions,
+            "breaker": {"open": br["open"], "half_open": br["half_open"],
+                        "trips": br["trips"]},
+            "degrade_fallback": self.degrade,
+            "last_dispatch_ok_age_s": age,
+            "state_dir": self.store.state_dir if self.store else None,
+            "faults_injected": (sum(self.faults.injected.values())
+                                if self.faults is not None else 0),
+        }
 
     def __len__(self) -> int:
         with self._lock:

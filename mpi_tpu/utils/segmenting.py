@@ -15,7 +15,7 @@ import jax
 from jax import lax
 
 
-def segmented_evolve(make_local, K: int):
+def segmented_evolve(make_local, K: int, donate: bool = True):
     """evolve(grid, steps): scan ``steps // K`` K-generation segments plus
     a single (steps % K)-generation remainder segment.
 
@@ -24,9 +24,26 @@ def segmented_evolve(make_local, K: int):
     (short runs never trace unused depth).  The returned ``evolve`` is
     jitted with donated input, so ``evolve.lower(grid, steps)`` works for
     ahead-of-time segment compilation.
-    """
 
-    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
+    ``donate=False``: for steppers that run NESTED inside another jitted
+    wrapper which still reads the same input after calling them — the
+    seam stitcher extracts its band from the PRE-step grid, then calls
+    the base stepper on that grid.  A donation hint on the nested call
+    lets XLA alias the base stepper's output onto the very buffer the
+    band extraction reads; on a multi-device mesh the per-device
+    programs race and a shard's input can be clobbered mid-read
+    (observed as nondeterministic whole-shard corruption on the
+    8-virtual-device CPU mesh).  The donation then belongs to the OUTER
+    wrapper's jit alone — peak memory is unchanged.
+    """
+    deco = (
+        functools.partial(jax.jit, static_argnames=("steps",),
+                          donate_argnums=0)
+        if donate else
+        functools.partial(jax.jit, static_argnames=("steps",))
+    )
+
+    @deco
     def evolve(grid, steps: int):
         k = max(1, min(K, steps))
         full, rem = divmod(steps, k)

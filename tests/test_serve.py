@@ -10,6 +10,7 @@ zero new XLA compiles, observed through the EngineCache counters and
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -486,3 +487,57 @@ def test_http_errors(server):
                       {"rows": 16, "cols": 16, "backend": "serial"})
     assert _req(server, "POST", f"/sessions/{created['id']}/step",
                 {"steps": "three"})[0] == 400
+
+
+def test_close_racing_batched_step():
+    """A close landing inside the coalescing window must yield a clean
+    KeyError (HTTP 404) for the closed board's step and never touch its
+    nulled grid; the surviving boards in the same window step normally
+    (the ISSUE 3 audit of serve/batch.py's closed-session checks)."""
+    mgr = SessionManager(EngineCache(max_size=4),
+                         batch_window_ms=200.0, batch_max=8)
+    a = mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "seed": 71})
+    b = mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "seed": 72})
+    results, errors = {}, {}
+
+    def go(sid):
+        try:
+            results[sid] = mgr.step(sid, 1)
+        except Exception as e:  # noqa: BLE001 — asserted below
+            errors[sid] = e
+
+    ta = threading.Thread(target=go, args=(a["id"],))
+    tb = threading.Thread(target=go, args=(b["id"],))
+    ta.start()
+    tb.start()
+    time.sleep(0.05)                    # both queued, leader still waiting
+    mgr.close(b["id"])                  # lands inside the window
+    ta.join()
+    tb.join()
+    assert isinstance(errors.get(b["id"]), KeyError)
+    assert results[a["id"]]["generation"] == 1
+    assert np.array_equal(_grid_of(mgr.snapshot(a["id"])),
+                          _oracle(64, 64, 71, 1))
+    with pytest.raises(KeyError):
+        mgr.snapshot(b["id"])
+
+
+def test_unexpected_exception_is_structured_500(server):
+    """A bug in a handler must answer structured JSON with a request id —
+    never http.server's HTML traceback page (the ISSUE 3 catch-all)."""
+    server.manager.stats = lambda: 1 / 0         # simulated internal bug
+    host, port = server.server_address[:2]
+    req = urllib.request.Request(f"http://{host}:{port}/stats")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            raise AssertionError(f"expected 500, got {resp.status}")
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        assert e.code == 500
+        assert e.headers.get("Content-Type") == "application/json"
+    body = json.loads(raw)                       # JSON, not an HTML page
+    assert "internal server error" in body["error"]
+    assert isinstance(body["request_id"], int)
+    assert b"Traceback" not in raw and b"<html" not in raw.lower()
+    # the connection and the server both survive the 500
+    assert _req(server, "GET", "/healthz")[0] == 200

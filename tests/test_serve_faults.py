@@ -1,0 +1,344 @@
+"""Tier-1 tests for the fault-tolerance machinery: the fault-injection
+DSL, retry/backoff, the per-signature circuit breaker, host-backend
+degradation (bit-identical by construction — it IS the oracle), the
+dispatch watchdog, and the deep /healthz — all on warm CPU shapes.
+
+These are the tests ISSUE 3 exists for: every recovery path is driven by
+deterministically injected failures, never by hoping hardware misbehaves
+on cue.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.config import ConfigError
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.serve import (
+    DeadlineError,
+    EngineCache,
+    EngineStepError,
+    EngineUnavailableError,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+)
+from mpi_tpu.serve.httpd import make_server
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+
+TPU_SPEC = {"rows": 64, "cols": 64, "backend": "tpu"}
+
+
+def _oracle(rows, cols, seed, steps, boundary="periodic", rule=LIFE):
+    return evolve_np(init_tile_np(rows, cols, seed), steps, rule, boundary)
+
+
+def _grid_of(snap):
+    return np.array([[int(c) for c in row] for row in snap["grid"]],
+                    dtype=np.uint8)
+
+
+# ------------------------------------------------------------ fault DSL
+
+
+def test_fault_plan_parses_the_grammar():
+    p = FaultPlan.parse("seed=7,step:3:raise,batched:2-4:hang:1.5,any:p0.25:delay")
+    assert p.seed == 7 and len(p.clauses) == 3
+    one, rng, prob = p.clauses
+    assert (one.site, one.lo, one.hi, one.mode) == ("step", 3, 3, "raise")
+    assert (rng.site, rng.lo, rng.hi, rng.seconds) == ("batched", 2, 4, 1.5)
+    assert (prob.site, prob.prob, prob.seconds) == ("any", 0.25, 0.05)
+    open_end = FaultPlan.parse("step:5+:raise").clauses[0]
+    assert (open_end.lo, open_end.hi) == (5, None)
+    assert FaultPlan.parse("any:*:delay:0").clauses[0].lo is None
+
+
+@pytest.mark.parametrize("bad", [
+    "", "step:1", "disk:1:raise", "step:1:explode", "step:0:raise",
+    "step:-1:raise", "step:p2:raise", "step:1:hang:-3", "seed=x,step:1:raise",
+    "step:one:raise",
+])
+def test_fault_plan_rejects_bad_specs(bad):
+    with pytest.raises(ConfigError):
+        FaultPlan.parse(bad)
+
+
+def test_injector_fires_on_the_nth_dispatch_only():
+    inj = FaultInjector.from_spec("step:2:raise")
+    inj.engine_hook("step")                     # 1st: clean
+    with pytest.raises(InjectedFault):
+        inj.engine_hook("step")                 # 2nd: boom
+    inj.engine_hook("step")                     # 3rd: clean again
+    assert inj.stats()["injected"]["raise"] == 1
+    assert inj.stats()["dispatches"]["step"] == 3
+
+
+def test_injector_any_site_counts_both_streams():
+    inj = FaultInjector.from_spec("any:3:raise")
+    inj.engine_hook("step")
+    inj.engine_hook("batched")
+    with pytest.raises(InjectedFault):
+        inj.engine_hook("step")                 # 3rd combined dispatch
+
+
+def test_injector_probabilistic_is_seed_deterministic():
+    def fire_pattern():
+        inj = FaultInjector.from_spec("seed=11,step:p0.5:raise")
+        out = []
+        for _ in range(20):
+            try:
+                inj.engine_hook("step")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = fire_pattern(), fire_pattern()
+    assert a == b and 0 < sum(a) < 20
+
+
+def test_injector_delay_mode_proceeds():
+    inj = FaultInjector.from_spec("step:1:delay:0.01")
+    t0 = time.perf_counter()
+    inj.engine_hook("step")                     # sleeps, then returns
+    assert time.perf_counter() - t0 >= 0.01
+    assert inj.stats()["injected"]["delay"] == 1
+
+
+# ------------------------------------------------------ retry + breaker
+
+
+def test_transient_fault_retries_and_succeeds():
+    mgr = SessionManager(EngineCache(max_size=4), step_retries=2,
+                         retry_backoff_s=0.001, faults="step:1:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=31))["id"]
+    r = mgr.step(sid, 1)                        # attempt 1 injected, 2 clean
+    assert r["generation"] == 1
+    assert mgr.engine_failures == 1
+    st = mgr.stats()
+    assert st["breaker"]["open"] == []          # success closed the count
+    assert st["breaker"]["consecutive_failures"] == 0
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)), _oracle(64, 64, 31, 1))
+    assert "last_error" in mgr.describe(mgr.get(sid))   # history kept
+
+
+def test_retries_exhausted_without_trip_is_503_and_recoverable():
+    cache = EngineCache(max_size=4, breaker_threshold=5)
+    mgr = SessionManager(cache, step_retries=1, retry_backoff_s=0.001,
+                         faults="step:1-2:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=33))["id"]
+    with pytest.raises(EngineStepError):
+        mgr.step(sid, 1)                        # 2 attempts, both injected
+    s = mgr.get(sid)
+    assert not s.degraded and s.generation == 0     # session intact
+    r = mgr.step(sid, 1)                        # dispatch 3: clean
+    assert r["generation"] == 1
+
+
+def test_breaker_trips_and_session_degrades_with_parity():
+    """ISSUE 3's breaker scenario: three injected step faults open the
+    breaker, the session falls back to the serial_np oracle, results stay
+    bit-identical, and stats/describe/healthz all say so."""
+    cache = EngineCache(max_size=4, breaker_threshold=3,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=2, retry_backoff_s=0.001,
+                         faults="step:1-3:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=41))["id"]
+    r = mgr.step(sid, 1)        # 3 failures -> breaker opens -> degrade
+    assert r["generation"] == 1
+    s = mgr.get(sid)
+    assert s.degraded and s.engine is None
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)), _oracle(64, 64, 41, 1))
+    mgr.step(sid, 3)            # keeps serving on the fallback
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)), _oracle(64, 64, 41, 4))
+    d = mgr.describe(s)
+    assert d["degraded"] and d["active_backend"] == "serial_np"
+    st = mgr.stats()
+    assert len(st["breaker"]["open"]) == 1 and st["breaker"]["trips"] == 1
+    assert st["failures"]["degraded_sessions"] == 1
+    assert st["faults"]["injected"]["raise"] == 3
+    h = mgr.health()
+    assert h["ok"] and h["degraded_sessions"] == 1  # degraded-but-serving
+
+
+def test_create_on_open_breaker_is_degraded_from_birth():
+    cache = EngineCache(max_size=4, breaker_threshold=3,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=2, retry_backoff_s=0.001,
+                         faults="step:1-3:raise")
+    a = mgr.create(dict(TPU_SPEC, seed=43))["id"]
+    mgr.step(a, 1)                              # trips the breaker
+    b = mgr.create(dict(TPU_SPEC, seed=44))     # same plan: quarantined
+    assert b["degraded"] is True
+    mgr.step(b["id"], 2)
+    assert np.array_equal(_grid_of(mgr.snapshot(b["id"])),
+                          _oracle(64, 64, 44, 2))
+
+
+def test_no_degrade_answers_503_and_healthz_degrades():
+    cache = EngineCache(max_size=4, breaker_threshold=2,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=3, retry_backoff_s=0.001,
+                         degrade=False, faults="step:1-2:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=47))["id"]
+    with pytest.raises(EngineUnavailableError):
+        mgr.step(sid, 1)
+    s = mgr.get(sid)
+    assert not s.degraded and s.engine is not None and s.generation == 0
+    assert mgr.health()["ok"] is False          # degraded, no fallback
+    with pytest.raises(EngineUnavailableError):
+        mgr.create(dict(TPU_SPEC, seed=48))     # same quarantined plan
+
+
+def test_breaker_half_open_trial_recovers():
+    cache = EngineCache(max_size=4, breaker_threshold=2,
+                        breaker_cooldown_s=0.05)
+    mgr = SessionManager(cache, step_retries=1, retry_backoff_s=0.001,
+                         degrade=False, faults="step:1-2:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=51))["id"]
+    with pytest.raises(EngineUnavailableError):
+        mgr.step(sid, 1)
+    time.sleep(0.06)                            # cooldown -> half-open
+    assert cache.breaker_stats()["half_open"]
+    r = mgr.step(sid, 1)                        # trial dispatch is clean
+    assert r["generation"] == 1
+    assert cache.breaker_stats()["open"] == []  # success closed it
+
+
+# --------------------------------------------------- watchdog deadlines
+
+
+def test_hung_dispatch_becomes_503_session_survives():
+    mgr = SessionManager(EngineCache(max_size=4), request_timeout_s=0.3,
+                         step_retries=0, faults="step:1:hang:1.0")
+    sid = mgr.create(dict(TPU_SPEC, seed=53))["id"]
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineError):
+        mgr.step(sid, 1)
+    assert time.monotonic() - t0 < 0.9          # the handler walked free
+    assert mgr.watchdog_timeouts == 1
+    time.sleep(1.0)                             # abandoned worker drains
+    r = mgr.step(sid, 1)                        # board intact, steps fine
+    assert r["generation"] == 1
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)), _oracle(64, 64, 53, 1))
+
+
+def test_wedged_board_times_out_other_verbs_cleanly():
+    """While a hung dispatch holds the session lock, other verbs on that
+    board answer their own deadline 503 instead of queueing forever."""
+    mgr = SessionManager(EngineCache(max_size=4), request_timeout_s=0.25,
+                         step_retries=0, faults="step:1:hang:1.2")
+    sid = mgr.create(dict(TPU_SPEC, seed=57))["id"]
+    with pytest.raises(DeadlineError):
+        mgr.step(sid, 1)                        # wedges the worker
+    with pytest.raises(DeadlineError):
+        mgr.snapshot(sid)                       # lock held -> own 503
+    time.sleep(1.2)
+    assert mgr.snapshot(sid)["generation"] == 0  # intact after the drain
+
+
+def test_per_request_timeout_override():
+    mgr = SessionManager(EngineCache(max_size=4), request_timeout_s=None,
+                         step_retries=0, faults="step:1:hang:0.8")
+    sid = mgr.create(dict(TPU_SPEC, seed=59))["id"]
+    with pytest.raises(DeadlineError):
+        mgr.step(sid, 1, timeout_s=0.2)         # override enables a budget
+    time.sleep(0.8)
+    assert mgr.step(sid, 1)["generation"] == 1
+
+
+# ----------------------------------------------------------- over HTTP
+
+
+def _serve(mgr):
+    srv = make_server(port=0, manager=mgr)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t
+
+
+def _req(srv, method, path, body=None):
+    import json
+    import urllib.error
+    import urllib.request
+
+    host, port = srv.server_address[:2]
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_fault_outcomes_and_deep_healthz():
+    cache = EngineCache(max_size=4, breaker_threshold=2,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=1, retry_backoff_s=0.001,
+                         faults="step:1-2:raise")
+    srv, t = _serve(mgr)
+    try:
+        code, created = _req(srv, "POST", "/sessions", dict(TPU_SPEC, seed=61))
+        assert code == 200
+        sid = created["id"]
+        code, r = _req(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+        assert code == 200 and r["generation"] == 1     # degraded, served
+        code, h = _req(srv, "GET", "/healthz")
+        assert code == 200 and h["degraded_sessions"] == 1
+        assert len(h["breaker"]["open"]) == 1 and h["breaker"]["trips"] == 1
+        assert h["faults_injected"] == 2
+        assert h["last_dispatch_ok_age_s"] is None      # no clean engine yet
+        code, st = _req(srv, "GET", "/stats")
+        assert code == 200 and st["failures"]["degraded_sessions"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
+def test_http_healthz_503_when_degraded_without_fallback():
+    cache = EngineCache(max_size=4, breaker_threshold=2,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=3, retry_backoff_s=0.001,
+                         degrade=False, faults="step:1-2:raise")
+    srv, t = _serve(mgr)
+    try:
+        code, created = _req(srv, "POST", "/sessions", dict(TPU_SPEC, seed=63))
+        sid = created["id"]
+        code, body = _req(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+        assert code == 503 and "breaker" in body["error"]
+        assert "request_id" in body
+        code, h = _req(srv, "GET", "/healthz")
+        assert code == 503 and h["ok"] is False
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
+def test_http_timeout_query_param():
+    mgr = SessionManager(EngineCache(max_size=4), step_retries=0,
+                         faults="step:1:hang:1.0")
+    srv, t = _serve(mgr)
+    try:
+        code, created = _req(srv, "POST", "/sessions", dict(TPU_SPEC, seed=67))
+        sid = created["id"]
+        code, body = _req(srv, "POST", f"/sessions/{sid}/step?timeout_s=0.2",
+                          {"steps": 1})
+        assert code == 503 and "budget" in body["error"]
+        code, body = _req(srv, "POST", f"/sessions/{sid}/step?timeout_s=oops",
+                          {"steps": 1})
+        assert code == 400
+        time.sleep(1.0)                         # drain the abandoned worker
+        code, r = _req(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+        assert code == 200 and r["generation"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)

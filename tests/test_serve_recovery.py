@@ -1,0 +1,233 @@
+"""Tier-1 tests for checkpoint/restore (``serve/recovery.py`` +
+``SessionManager`` state-dir wiring) — all on CPU devices, all on the
+warm 64x64 shapes the rest of the serve suite compiles.
+
+The headline property is ISSUE 3's acceptance criterion: a session that
+lives through a crash (simulated by a fresh manager over the same state
+dir, and once for real by SIGKILLing a server subprocess) must be
+bit-identical to the same session stepped without the crash — restore is
+deterministic replay, and replay is exact (PARITY.md).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.serve import recovery
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+
+
+def _oracle(rows, cols, seed, steps, boundary="periodic", rule=LIFE):
+    return evolve_np(init_tile_np(rows, cols, seed), steps, rule, boundary)
+
+
+def _grid_of(snap):
+    return np.array([[int(c) for c in row] for row in snap["grid"]],
+                    dtype=np.uint8)
+
+
+# ------------------------------------------------------------- store
+
+
+def test_grid_codec_roundtrip():
+    g = init_tile_np(13, 37, 5)                 # odd shape: packbits pads
+    assert np.array_equal(recovery.decode_grid(recovery.encode_grid(g)), g)
+
+
+def test_statestore_save_load_delete(tmp_path):
+    store = recovery.StateStore(str(tmp_path), checkpoint_every=8)
+    spec = {"rows": 16, "cols": 16, "backend": "serial", "seed": 3}
+    snap = recovery.encode_grid(init_tile_np(16, 16, 3))
+    snap["generation"] = 4
+    store.save("s2", spec, 7, snap)
+    store.save("s1", spec, 1, None)
+    recs = store.load_records()
+    assert [r["id"] for r in recs] == ["s1", "s2"]     # numeric sid order
+    assert recs[1]["generation"] == 7
+    assert recs[1]["snapshot"]["generation"] == 4
+    assert np.array_equal(recovery.decode_grid(recs[1]["snapshot"]),
+                          init_tile_np(16, 16, 3))
+    store.delete("s1")
+    assert [r["id"] for r in store.load_records()] == ["s2"]
+    st = store.stats()
+    assert st["writes"] == 2 and st["snapshot_writes"] == 1
+    assert st["deletes"] == 1 and st["load_errors"] == 0
+
+
+def test_statestore_skips_corrupt_and_alien_files(tmp_path):
+    store = recovery.StateStore(str(tmp_path))
+    spec = {"rows": 16, "cols": 16, "backend": "serial"}
+    store.save("s1", spec, 2, None)
+    (tmp_path / "s9.json").write_text("{torn json")       # crash-mangled
+    (tmp_path / "s8.json").write_text('{"v": 99, "id": "s8"}')  # alien
+    (tmp_path / "notes.txt").write_text("not a record")   # ignored
+    recs = store.load_records()
+    assert [r["id"] for r in recs] == ["s1"]
+    assert store.stats()["load_errors"] == 2
+
+
+# ------------------------------------------------------------- restore
+
+
+def test_host_restore_parity(tmp_path):
+    """create -> step k -> 'crash' -> restore -> step m must equal an
+    uninterrupted k+m run bit for bit (host backend)."""
+    k, m = 7, 5
+    m1 = SessionManager(state_dir=str(tmp_path), checkpoint_every=4)
+    sid = m1.create({"rows": 48, "cols": 48, "backend": "serial",
+                     "seed": 9})["id"]
+    for _ in range(k):
+        m1.step(sid, 1)
+    before = _grid_of(m1.snapshot(sid))
+
+    m2 = SessionManager(state_dir=str(tmp_path))    # the "restart"
+    assert m2.restored_sessions == 1
+    s = m2.get(sid)
+    assert s.restored and s.generation == k
+    assert np.array_equal(_grid_of(m2.snapshot(sid)), before)
+    for _ in range(m):
+        m2.step(sid, 1)
+    assert np.array_equal(_grid_of(m2.snapshot(sid)),
+                          _oracle(48, 48, 9, k + m))
+    d = m2.describe(s)
+    assert d["restored"] is True
+    assert m2.stats()["recovery"]["restored_sessions"] == 1
+    assert m2.health()["restored_sessions"] == 1
+
+
+def test_tpu_restore_parity(tmp_path):
+    """Same property through the engine path: the restored board rides a
+    rebuilt engine (depth-1 replay — no fresh XLA shapes) and continued
+    stepping stays on the oracle."""
+    k, m = 5, 3
+    m1 = SessionManager(state_dir=str(tmp_path), checkpoint_every=3)
+    sid = m1.create({"rows": 64, "cols": 64, "backend": "tpu",
+                     "seed": 13})["id"]
+    for _ in range(k):
+        m1.step(sid, 1)
+    before = _grid_of(m1.snapshot(sid))
+
+    m2 = SessionManager(state_dir=str(tmp_path))
+    s = m2.get(sid)
+    assert s.restored and s.engine is not None and s.generation == k
+    assert np.array_equal(_grid_of(m2.snapshot(sid)), before)
+    for _ in range(m):
+        m2.step(sid, 1)
+    assert np.array_equal(_grid_of(m2.snapshot(sid)),
+                          _oracle(64, 64, 13, k + m))
+
+
+def test_restore_without_snapshot_replays_from_seed(tmp_path):
+    """Records saved before the first grid snapshot restore by replaying
+    the whole history from the seed."""
+    m1 = SessionManager(state_dir=str(tmp_path), checkpoint_every=1000)
+    sid = m1.create({"rows": 32, "cols": 32, "backend": "serial",
+                     "seed": 4})["id"]
+    m1.step(sid, 6)
+    m2 = SessionManager(state_dir=str(tmp_path))
+    assert np.array_equal(_grid_of(m2.snapshot(sid)), _oracle(32, 32, 4, 6))
+
+
+def test_close_deletes_record_and_new_ids_advance(tmp_path):
+    m1 = SessionManager(state_dir=str(tmp_path))
+    a = m1.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    b = m1.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    m1.close(a)
+    m2 = SessionManager(state_dir=str(tmp_path))
+    with pytest.raises(KeyError):
+        m2.get(a)
+    assert m2.get(b) is not None
+    # the id counter resumes past restored ids — no sid collisions
+    c = m2.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    assert c not in (a, b)
+
+
+def test_restore_salvages_around_bad_record(tmp_path):
+    m1 = SessionManager(state_dir=str(tmp_path))
+    sid = m1.create({"rows": 16, "cols": 16, "backend": "serial",
+                     "seed": 2})["id"]
+    m1.step(sid, 3)
+    (tmp_path / "s7.json").write_text(json.dumps({
+        "v": 1, "id": "s7", "generation": 1,
+        "spec": {"rows": 16, "cols": 16, "backend": "nope"},  # bad backend
+    }))
+    m2 = SessionManager(state_dir=str(tmp_path))
+    assert m2.restored_sessions == 1 and m2.restore_errors == 1
+    assert np.array_equal(_grid_of(m2.snapshot(sid)), _oracle(16, 16, 2, 3))
+
+
+# ------------------------------------------------------- real SIGKILL
+
+
+def _wait_for_serving(proc):
+    """The bound address from the server's startup line."""
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError("server exited before announcing its port")
+        if "serving on http://" in line:
+            addr = line.split("http://", 1)[1].split(" ", 1)[0]
+            host, port = addr.rsplit(":", 1)
+            return host, int(port)
+    raise AssertionError("server never announced its port")
+
+
+def _http(host, port, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_sigkill_restart_restores_sessions(tmp_path):
+    """The acceptance-criterion crash: SIGKILL the serving process
+    mid-run, restart on the same --state-dir, and the restored board is
+    bit-identical to an uninterrupted run.  Serial backend keeps the
+    subprocess jax-free and tier-1 fast."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = [sys.executable, "-m", "mpi_tpu.cli", "serve", "--port", "0",
+            "--state-dir", str(tmp_path), "--checkpoint-every", "4"]
+    k, m = 6, 4
+    p1 = subprocess.Popen(args, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, text=True, env=env)
+    try:
+        host, port = _wait_for_serving(p1)
+        sid = _http(host, port, "POST", "/sessions",
+                    {"rows": 32, "cols": 32, "backend": "serial",
+                     "seed": 21})["id"]
+        for _ in range(k):
+            _http(host, port, "POST", f"/sessions/{sid}/step", {"steps": 1})
+    finally:
+        p1.kill()                                   # SIGKILL, no shutdown
+        p1.wait(timeout=30)
+        p1.stdout.close()
+
+    p2 = subprocess.Popen(args, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, text=True, env=env)
+    try:
+        host, port = _wait_for_serving(p2)
+        health = _http(host, port, "GET", "/healthz")
+        assert health["restored_sessions"] == 1
+        for _ in range(m):
+            _http(host, port, "POST", f"/sessions/{sid}/step", {"steps": 1})
+        snap = _http(host, port, "GET", f"/sessions/{sid}/snapshot")
+        assert snap["generation"] == k + m
+        assert np.array_equal(_grid_of(snap), _oracle(32, 32, 21, k + m))
+    finally:
+        p2.kill()
+        p2.wait(timeout=30)
+        p2.stdout.close()

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
@@ -417,6 +418,7 @@ class Engine:
         self._compile_lock = threading.Lock()
         self.compile_count = 0
         self.batched_compile_count = 0
+        self.compile_wall_s = 0.0
         self.step_calls = 0
         self.batched_step_calls = 0
         self._unpacker = None
@@ -424,6 +426,10 @@ class Engine:
         # ('step' | 'batched'); the serve layer installs its fault injector
         # here so recovery paths are testable without sick hardware
         self.fault_hook = None
+        # optional mpi_tpu.obs.Obs handle installed by the serve layer;
+        # only consulted on the compile (miss) path — the per-dispatch
+        # hot path stays untouched so obs=None is the pre-obs code
+        self.obs = None
 
     @property
     def col_limit(self):
@@ -469,10 +475,16 @@ class Engine:
             c = self._compiled.get(n)
             if c is not None:
                 return c
+            t0 = time.perf_counter()
             c = self._compile_with_fallback(
                 lambda: self._evolve.lower(grid, n).compile())
+            dt = time.perf_counter() - t0
             self._compiled[n] = c
             self.compile_count += 1
+            self.compile_wall_s += dt
+            if self.obs is not None:
+                self.obs.compile_wall.observe(dt)
+                self.obs.event("compile", dt, t0, depth=n)
             return c
 
     def ensure_compiled_batched(self, grids, n: int):
@@ -489,11 +501,17 @@ class Engine:
             c = self._compiled_batched.get(key)
             if c is not None:
                 return c
+            t0 = time.perf_counter()
             c = self._compile_with_fallback(
                 lambda: self._get_batched_evolve().lower(grids, n).compile())
+            dt = time.perf_counter() - t0
             self._compiled_batched[key] = c
             self.compile_count += 1
             self.batched_compile_count += 1
+            self.compile_wall_s += dt
+            if self.obs is not None:
+                self.obs.compile_wall.observe(dt)
+                self.obs.event("compile", dt, t0, depth=n, B=key[1])
             return c
 
     def _compile_with_fallback(self, compile_fn):

@@ -1,0 +1,275 @@
+"""Counters, gauges, and fixed-bucket histograms with a Prometheus
+text-format renderer — the serve stack's metrics channel.
+
+Stdlib-only and deliberately tiny: the serving stack needs exactly three
+instrument kinds (the ones every production inference server is tuned
+off — Orca-style occupancy/latency histograms, breaker/cache gauges,
+dispatch counters), not a client-library dependency.  All mutation goes
+through one registry lock; ``observe``/``inc`` are a dict lookup plus an
+integer bump (~1 µs), cheap enough for the step hot path, and rendering
+walks the registry only at scrape time (``GET /metrics``).
+
+Two callback flavors (``gauge_fn``/``counter_fn``) evaluate at scrape
+time instead of being pushed: values that already live somewhere
+authoritative (engine compile counters, breaker states, queue depth)
+must not be shadow-counted — double bookkeeping is how metrics drift
+from the truth they claim to report.  Registration is idempotent by
+name so re-binding a manager to a registry never raises.
+
+Histogram buckets are FIXED at creation (cumulative ``le`` semantics,
+``+Inf`` implied): fixed buckets make ``observe`` O(log n_buckets) with
+zero allocation, and bucket counts are monotone by construction — the
+property ``tests/test_obs.py`` asserts on the rendered text.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Dispatch/request latencies: 0.5 ms (CPU dispatch floor) up to 10 s
+# (a watchdogged hang) — PERF.md's ~68 ms TPU tunnel constant sits
+# mid-range where the resolution is finest.
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# Batch occupancy B: bounded by --batch-max (default 8), headroom to 32.
+OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+# XLA/Mosaic compile wall: ~10 ms warm-cache reloads to multi-minute
+# cold sharded compiles (PERF.md's compile-wall artifact).
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0)
+# Checkpoint/restore file+replay work: sub-ms JSON rewrites to
+# multi-second replays.
+IO_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, floats minimally."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _labels_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, lock: threading.Lock):
+        self.name = name
+        self.help = help_
+        self._lock = lock
+
+    def _header(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.kind}"]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, lock):
+        super().__init__(name, help_, lock)
+        self._vals: Dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        k = _key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0.0) + value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._vals.get(_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = sorted(self._vals.items())
+        for k, v in items:
+            out.append(f"{self.name}{_labels_str(k)} {_fmt(v)}")
+        if not items:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._vals[_key(labels)] = float(value)
+
+
+class _FnMetric(_Metric):
+    """Scrape-time callback metric.  ``fn`` returns a number or a list of
+    ``(labels_dict, value)`` pairs; a raising callback renders nothing —
+    a scrape must never 500 because one provider hiccuped."""
+
+    def __init__(self, name, help_, lock, fn: Callable, kind: str):
+        super().__init__(name, help_, lock)
+        self._fn = fn
+        self.kind = kind
+
+    def render(self) -> List[str]:
+        try:
+            val = self._fn()
+        except Exception:  # noqa: BLE001 — scrape survives a sick provider
+            return []
+        out = self._header()
+        if isinstance(val, (int, float)):
+            out.append(f"{self.name} {_fmt(float(val))}")
+        else:
+            for labels, v in val:
+                out.append(f"{self.name}{_labels_str(_key(labels))} "
+                           f"{_fmt(float(v))}")
+        return out
+
+
+class _BoundSeries:
+    """A histogram series pre-resolved to its label set — the hot-path
+    handle.  ``observe`` skips the per-call kwargs dict and label-key
+    sort (the expensive half of a labeled observe), leaving lock +
+    bisect + three increments (~0.6 µs)."""
+
+    __slots__ = ("_lock", "_buckets", "_st")
+
+    def __init__(self, lock, buckets, st):
+        self._lock = lock
+        self._buckets = buckets
+        self._st = st
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            st = self._st
+            st[0][bisect.bisect_left(self._buckets, value)] += 1
+            st[1] += value
+            st[2] += 1
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, lock, buckets):
+        super().__init__(name, help_, lock)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+        # label-key -> [per-bucket counts (+1 overflow slot), sum, count]
+        self._series: Dict[tuple, list] = {}
+
+    def series(self, **labels) -> _BoundSeries:
+        """The pre-bound handle for ``labels`` (created empty if new) —
+        bind once at wiring time, observe cheaply per step."""
+        k = _key(labels)
+        with self._lock:
+            st = self._series.get(k)
+            if st is None:
+                st = self._series[k] = [[0] * (len(self.buckets) + 1),
+                                        0.0, 0]
+        return _BoundSeries(self._lock, self.buckets, st)
+
+    def observe(self, value: float, **labels) -> None:
+        k = _key(labels)
+        with self._lock:
+            st = self._series.get(k)
+            if st is None:
+                st = self._series[k] = [[0] * (len(self.buckets) + 1),
+                                        0.0, 0]
+            # le semantics: first bound with value <= bound
+            st[0][bisect.bisect_left(self.buckets, value)] += 1
+            st[1] += value
+            st[2] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            st = self._series.get(_key(labels))
+            return st[2] if st else 0
+
+    def render(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = [(k, (list(st[0]), st[1], st[2]))
+                     for k, st in sorted(self._series.items())]
+        for k, (counts, total, n) in items:
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                labels = k + (("le", "%g" % bound),)
+                out.append(f"{self.name}_bucket{_labels_str(labels)} {cum}")
+            cum += counts[-1]
+            out.append(
+                f"{self.name}_bucket{_labels_str(k + (('le', '+Inf'),))} "
+                f"{cum}")
+            out.append(f"{self.name}_sum{_labels_str(k)} {_fmt(total)}")
+            out.append(f"{self.name}_count{_labels_str(k)} {n}")
+        return out
+
+
+class MetricsRegistry:
+    """Named instruments + the text renderer behind ``GET /metrics``.
+
+    One lock serves every instrument: contention is negligible (scrapes
+    are rare, mutations are sub-µs) and a single lock cannot deadlock.
+    Re-registering a name returns the existing instrument when the kind
+    matches (idempotent binding) and replaces it otherwise.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name, help_, *args):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None and type(existing) is cls \
+                    and not issubclass(cls, _FnMetric):
+                return existing
+        m = cls(name, help_, self._lock, *args)
+        with self._lock:
+            self._metrics[name] = m
+        return m
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self._register(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        return self._register(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str,
+                  buckets=LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help_, buckets)
+
+    def gauge_fn(self, name: str, help_: str, fn: Callable) -> None:
+        self._register(_FnMetric, name, help_, fn, "gauge")
+
+    def counter_fn(self, name: str, help_: str, fn: Callable) -> None:
+        self._register(_FnMetric, name, help_, fn, "counter")
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"

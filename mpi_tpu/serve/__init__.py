@@ -22,6 +22,12 @@ deadlines with a dispatch watchdog, a per-plan-signature circuit breaker
 that degrades sick engines to the bit-identical ``serial_np`` oracle,
 and deterministic fault injection (``serve/faults.py``) to drive every
 recovery path under test.
+
+Observability (PR 4, ``mpi_tpu.obs``) threads through every layer as an
+optional :class:`~mpi_tpu.obs.Obs` handle (``SessionManager(obs=...)``):
+request-id-tagged trace spans, Prometheus-text ``GET /metrics``, and
+``POST /debug/profile`` device captures — all off (and off the hot
+path) when the handle is None.
 """
 
 from mpi_tpu.serve.batch import MicroBatcher

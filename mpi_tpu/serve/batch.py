@@ -29,12 +29,21 @@ from __future__ import annotations
 import threading
 import time
 
+from mpi_tpu.obs.trace import (
+    current_request_id, reset_request_id, set_request_id,
+)
+
 
 class _Entry:
     """One enqueued step request: filled with either ``result`` or
-    ``error`` by the leader, then ``event`` wakes the waiting thread."""
+    ``error`` by the leader, then ``event`` wakes the waiting thread.
+    ``rid`` carries the submitter's request id across the thread hop —
+    the leader runs follower work on ITS thread, so the contextvar set
+    by the HTTP handler does not flow; the leader re-enters each entry's
+    id around its commit so downstream spans (checkpoint writes) land
+    under the request that asked for them."""
 
-    __slots__ = ("session", "steps", "event", "result", "error")
+    __slots__ = ("session", "steps", "event", "result", "error", "rid")
 
     def __init__(self, session, steps: int):
         self.session = session
@@ -42,6 +51,7 @@ class _Entry:
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.rid = current_request_id()
 
 
 class MicroBatcher:
@@ -94,13 +104,24 @@ class MicroBatcher:
                 leader = False
         if leader:
             if self.window_s:
+                t0 = time.perf_counter()
                 time.sleep(self.window_s)
+                if manager.obs is not None:
+                    manager.obs.event("batch_window",
+                                      time.perf_counter() - t0, t0,
+                                      sid=session.id)
             self._run_leader(manager, key)
         else:
             entry.event.wait()
         if entry.error is not None:
             raise entry.error
         return entry.result
+
+    def queue_depth(self) -> int:
+        """Entries currently waiting in coalescing queues (scraped as the
+        ``mpi_tpu_batch_queue_depth`` gauge)."""
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
 
     def stats(self) -> dict:
         with self._lock:
@@ -204,11 +225,16 @@ class MicroBatcher:
                 e.event.set()
 
     def _step_solo(self, manager, entry, steps: int) -> None:
+        # re-enter the submitter's request id: this runs on the LEADER's
+        # thread, whose contextvar belongs to a different request
+        token = set_request_id(entry.rid)
         t0 = time.perf_counter()
         try:
             entry.result = manager._step_locked(entry.session, steps)
         except Exception as e:  # noqa: BLE001 — delivered to the waiter
             entry.error = e
+        finally:
+            reset_request_id(token)
         with self._lock:
             self.solo_steps += 1
             self.solo_step_s += time.perf_counter() - t0
@@ -242,6 +268,15 @@ class MicroBatcher:
             for e in group:
                 self._step_solo(manager, e, steps)
             return
+        obs = manager.obs
+        if obs is not None:
+            # one dispatch serves B requests: the span lists every rid so
+            # any of them reconstructs this shared leg from the JSONL
+            obs.event("batched_dispatch", t2 - t1, t1, B=B, steps=steps,
+                      sids=[e.session.id for e in group],
+                      request_ids=[e.rid for e in group])
+            obs.occupancy_series.observe(B)
+            obs.dispatch_batched.observe(t2 - t1)
         for e, grid in zip(group, boards):
             s = e.session
             s.setup_s += t1 - t0
@@ -249,7 +284,13 @@ class MicroBatcher:
             s.grid = grid
             s.generation += steps
             s.batched_steps += 1
-            manager._checkpoint(s)      # session lock is held (leader)
+            # commit under the submitter's request id so the checkpoint
+            # write's span carries it (this is the leader's thread)
+            token = set_request_id(e.rid)
+            try:
+                manager._checkpoint(s)  # session lock is held (leader)
+            finally:
+                reset_request_id(token)
             e.result = {"id": s.id, "generation": s.generation,
                         "steps": steps, "batched": B}
         manager._mark_dispatch_ok()

@@ -140,6 +140,14 @@ class EngineCache:
                 self.batched_evictions += 1
             return stepper, False
 
+    def engines(self) -> list:
+        """A snapshot of the cached engines (the obs layer aggregates
+        their compile/dispatch counters at scrape time — live sessions
+        may hold evicted engines beyond these, which the caller unions
+        in)."""
+        with self._lock:
+            return list(self._entries.values())
+
     # -- circuit breaker ---------------------------------------------------
 
     def record_failure(self, signature: tuple) -> bool:

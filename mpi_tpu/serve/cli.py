@@ -81,6 +81,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic fault plan at the engine dispatch "
                    "boundary, e.g. 'step:3:raise' or 'any:2:hang:5' "
                    "(testing; env fallback MPI_TPU_FAULTS)")
+    p.add_argument("--no-obs", action="store_true",
+                   help="disable tracing/metrics entirely: /metrics answers "
+                   "404 and the step path runs uninstrumented "
+                   "(bit-identical results either way)")
+    p.add_argument("--trace-log", default=None, metavar="PATH",
+                   help="stream every trace span as a JSONL line to PATH "
+                   "(the ring buffer alone otherwise; dumped on any 500)")
+    p.add_argument("--trace-capacity", type=int, default=4096,
+                   help="span ring-buffer size (oldest spans overwritten)")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="arm POST /debug/profile?secs=N: captures a "
+                   "jax.profiler device trace into DIR (off when unset)")
     return p
 
 
@@ -94,6 +106,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 
     apply_platform_override()
     faults = args.inject_faults or os.environ.get("MPI_TPU_FAULTS") or None
+    obs = None
+    if not args.no_obs:
+        from mpi_tpu.obs import Obs
+
+        obs = Obs(trace_capacity=args.trace_capacity,
+                  trace_log=args.trace_log)
     try:
         manager = SessionManager(
             EngineCache(max_size=args.cache_size,
@@ -109,11 +127,13 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             retry_backoff_s=args.retry_backoff_ms / 1e3,
             degrade=not args.no_degrade,
             faults=faults,
+            obs=obs,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    server = make_server(args.host, args.port, manager, verbose=args.verbose)
+    server = make_server(args.host, args.port, manager, verbose=args.verbose,
+                         profile_dir=args.profile_dir)
     host, port = server.server_address[:2]
     batch = ("off" if args.no_batch else
              f"window {args.batch_window_ms}ms max {args.batch_max}")
@@ -124,6 +144,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             extras.append(f"restored {manager.restored_sessions}")
     if faults:
         extras.append(f"faults '{faults}'")
+    if args.no_obs:
+        extras.append("obs off")
+    elif args.trace_log:
+        extras.append(f"trace-log {args.trace_log}")
+    if args.profile_dir:
+        extras.append(f"profile-dir {args.profile_dir}")
     extra = (", " + ", ".join(extras)) if extras else ""
     print(f"[mpi_tpu] serving on http://{host}:{port} "
           f"(cache size {args.cache_size}, batch {batch}{extra})", flush=True)
@@ -133,6 +159,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         print("[mpi_tpu] shutting down", flush=True)
     finally:
         server.server_close()
+        if obs is not None:
+            obs.close()                 # flush + fsync the trace log
     return 0
 
 

@@ -11,6 +11,21 @@ Routes (all bodies and responses are JSON):
     GET    /stats                      cache counters + per-session throughput
                                        + microbatch occupancy/amortization
                                        (the ``batch`` section, when enabled)
+    GET    /metrics                    Prometheus text exposition (the one
+                                       non-JSON route; 404 when the manager
+                                       runs with obs disabled)
+    POST   /debug/profile?secs=N       capture a jax.profiler device trace
+                                       over live traffic (requires
+                                       --profile-dir; one capture at a time)
+
+Observability (PR 4): every request's id is entered into the obs
+request-id contextvar for its whole handling, so spans recorded anywhere
+downstream — session lock waits, batched dispatches on the leader's
+thread, checkpoint writes, watchdog workers — carry the same id as the
+``http_request`` span and the access-log line.  The catch-all 500
+additionally dumps the trace ring to disk (or points at the live
+``--trace-log``) so the evidence for a crash report survives the
+process.
 
 Errors: 400 with {"error": ...} for bad specs/bodies (``ConfigError``/
 ``ValueError``), 404 for unknown sessions and routes, 503 for fault-
@@ -46,6 +61,7 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from mpi_tpu.config import ConfigError
+from mpi_tpu.obs.trace import reset_request_id, set_request_id
 from mpi_tpu.serve.session import (
     DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
 )
@@ -63,12 +79,20 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing ----------------------------------------------------------
 
     def _reply(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+        self._reply_bytes(code, json.dumps(payload).encode(),
+                          "application/json")
+
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        self._reply_bytes(code, text.encode("utf-8"), content_type)
+
+    def _reply_bytes(self, code: int, body: bytes,
+                     content_type: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+        self._last_code = code          # the http_request span's code tag
         if getattr(self.server, "verbose", False):
             print(f"[mpi_tpu] request {getattr(self, '_rid', '?')}: "
                   f"{self.command} {self.path} -> {code}", file=sys.stderr)
@@ -107,6 +131,10 @@ class _Handler(BaseHTTPRequestHandler):
             return "healthz", None, None
         if parts == ["stats"]:
             return "stats", None, None
+        if parts == ["metrics"]:
+            return "metrics", None, None
+        if parts == ["debug", "profile"]:
+            return "profile", None, None
         if parts and parts[0] == "sessions":
             if len(parts) == 1:
                 return "sessions", None, None
@@ -117,11 +145,39 @@ class _Handler(BaseHTTPRequestHandler):
         return "unknown", None, None
 
     def _dispatch(self, method: str) -> None:
-        mgr: SessionManager = self.server.manager
         rid = next(self.server.request_ids)
         self._rid = rid                     # _reply's verbose outcome line
+        self._last_code = 0
+        obs = getattr(self.server, "obs", None)
+        if obs is None:
+            return self._handle(method, rid, None)
+        # one shared id per request: every span recorded while this
+        # request is being handled — in this thread, in the watchdog
+        # worker (context copied), in the batch leader (entry.rid) —
+        # carries it, which is what makes the JSONL reconstructable
+        token = set_request_id(rid)
+        try:
+            with obs.span("http_request", method=method,
+                          path=self.path) as sp:
+                self._handle(method, rid, obs)
+                sp.tag(code=self._last_code)
+            obs.http_requests.inc(method=method, code=self._last_code)
+        finally:
+            reset_request_id(token)
+
+    def _handle(self, method: str, rid: int, obs) -> None:
+        mgr: SessionManager = self.server.manager
         kind, sid, verb = self._route()
         try:
+            if kind == "metrics" and method == "GET":
+                if obs is None:
+                    return self._reply(404, {
+                        "error": "observability is disabled (--no-obs)"})
+                return self._reply_text(
+                    200, obs.render_metrics(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            if kind == "profile" and method == "POST":
+                return self._profile()
             if kind == "healthz" and method == "GET":
                 health = mgr.health()
                 return self._reply(200 if health["ok"] else 503, health)
@@ -165,10 +221,37 @@ class _Handler(BaseHTTPRequestHandler):
             print(f"[mpi_tpu] request {rid}: unhandled "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             traceback.print_exc(file=sys.stderr)
-            return self._reply(500, {
+            payload = {
                 "error": f"internal server error ({type(e).__name__})",
                 "request_id": rid,
-            })
+            }
+            if obs is not None:
+                # flush the evidence: the ring (or live --trace-log)
+                # holds the request's spans up to the failure point
+                dump = obs.tracer.dump_on_crash(
+                    f"request {rid}: {type(e).__name__}: {e}")
+                if dump:
+                    payload["trace_dump"] = dump
+                    print(f"[mpi_tpu] request {rid}: trace dumped to "
+                          f"{dump}", file=sys.stderr)
+            return self._reply(500, payload)
+
+    def _profile(self) -> None:
+        logdir = getattr(self.server, "profile_dir", None)
+        if logdir is None:
+            return self._reply(404, {
+                "error": "profiling is disabled "
+                         "(start the server with --profile-dir)"})
+        qs = parse_qs(urlsplit(self.path).query)
+        raw = qs["secs"][0] if "secs" in qs else "1"
+        try:
+            secs = float(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(f"secs must be a number, got {raw!r}")
+        from mpi_tpu.obs.profile import run_profile
+
+        result = run_profile(logdir, secs)
+        return self._reply(200 if result["ok"] else 503, result)
 
     # -- verbs -------------------------------------------------------------
 
@@ -184,12 +267,18 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(host: str = "127.0.0.1", port: int = 0,
                 manager: Optional[SessionManager] = None,
-                verbose: bool = False) -> ThreadingHTTPServer:
+                verbose: bool = False,
+                profile_dir: Optional[str] = None) -> ThreadingHTTPServer:
     """A ready-to-run server (not yet serving — call ``serve_forever`` or
     drive it from a thread; ``port=0`` binds an ephemeral port, which the
-    tests use).  The bound address is ``server.server_address``."""
+    tests use).  The bound address is ``server.server_address``.
+    Observability rides on the manager: ``manager.obs`` (or None) decides
+    whether ``/metrics`` serves and spans record; ``profile_dir`` arms
+    ``POST /debug/profile``."""
     server = ThreadingHTTPServer((host, port), _Handler)
     server.manager = manager if manager is not None else SessionManager()
     server.verbose = verbose
     server.request_ids = itertools.count(1)
+    server.obs = server.manager.obs
+    server.profile_dir = profile_dir
     return server

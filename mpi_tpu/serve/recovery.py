@@ -32,6 +32,7 @@ import base64
 import json
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -84,6 +85,7 @@ class StateStore:
         self._lock = threading.Lock()
         self._tmp_seq = 0
         self.writes = 0
+        self.write_s = 0.0              # accumulated save wall (obs reads it)
         self.snapshot_writes = 0
         self.deletes = 0
         self.load_errors = 0
@@ -111,6 +113,7 @@ class StateStore:
             "snapshot": snapshot,
         }
         path = self._path(sid)
+        t0 = time.perf_counter()
         with self._lock:
             self._tmp_seq += 1
             tmp = f"{path}.tmp{self._tmp_seq}"
@@ -121,6 +124,7 @@ class StateStore:
         os.replace(tmp, path)
         with self._lock:
             self.writes += 1
+            self.write_s += time.perf_counter() - t0
             if snapshot is not None:
                 self.snapshot_writes += 1
 
@@ -171,6 +175,7 @@ class StateStore:
                 "state_dir": self.state_dir,
                 "checkpoint_every": self.checkpoint_every,
                 "writes": self.writes,
+                "write_s": round(self.write_s, 6),
                 "snapshot_writes": self.snapshot_writes,
                 "deletes": self.deletes,
                 "load_errors": self.load_errors,

@@ -48,6 +48,8 @@ Fault tolerance (PR 3) wraps the whole step path:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import sys
 import threading
 import time
@@ -67,6 +69,15 @@ _SPEC_KEYS = {
     "rows", "cols", "rule", "boundary", "backend", "seed", "comm_every",
     "overlap", "mesh", "segments",
 }
+
+
+def _span(obs, name, **fields):
+    """A trace span when observability is on, a no-op context otherwise —
+    the guard every instrumentation site in this module goes through, so
+    ``obs=None`` runs the pre-obs code path exactly."""
+    if obs is None:
+        return contextlib.nullcontext()
+    return obs.span(name, **fields)
 
 
 class DeadlineError(RuntimeError):
@@ -165,10 +176,13 @@ def _watchdog_call(fn, deadline: _Deadline, label: str):
         return fn()
     box = {}
     done = threading.Event()
+    # carry the caller's context (the per-request id contextvar) into the
+    # worker, so spans recorded under the watchdog still tag the request
+    ctx = contextvars.copy_context()
 
     def run():
         try:
-            box["result"] = fn()
+            box["result"] = ctx.run(fn)
         except BaseException as e:  # noqa: BLE001 — re-raised in the caller
             box["error"] = e
         finally:
@@ -253,7 +267,9 @@ class SessionManager:
                  step_retries: int = 2,
                  retry_backoff_s: float = 0.05,
                  degrade: bool = True,
-                 faults=None):
+                 faults=None,
+                 obs=None):
+        self.obs = obs                  # mpi_tpu.obs.Obs or None (off)
         self.cache = cache if cache is not None else EngineCache()
         self.batcher = (
             MicroBatcher(window_ms=batch_window_ms, max_batch=batch_max)
@@ -285,6 +301,8 @@ class SessionManager:
         self.restore_errors = 0
         self.store_errors = 0
         self._last_dispatch_ok: Optional[float] = None
+        if self.obs is not None:
+            self.obs.bind_manager(self)
         if self.store is not None:
             self._restore_all()
 
@@ -303,10 +321,12 @@ class SessionManager:
     def _create(self, spec: dict) -> dict:
         config, segments = _parse_spec(spec)
         t0 = time.perf_counter()
-        if config.backend == "tpu":
-            session = self._create_tpu(config, segments)
-        else:
-            session = self._create_host(config)
+        with _span(self.obs, "create", backend=config.backend,
+                   rows=config.rows, cols=config.cols):
+            if config.backend == "tpu":
+                session = self._create_tpu(config, segments)
+            else:
+                session = self._create_host(config)
         session.setup_s = time.perf_counter() - t0
         session.spec = dict(spec)
         with self._lock:
@@ -339,6 +359,9 @@ class SessionManager:
         if self.faults is not None:
             # idempotent: cached engines get the same hook re-installed
             engine.fault_hook = self.faults.engine_hook
+        # same idempotent-install idiom: a cached engine follows THIS
+        # manager's obs setting (None detaches a previous manager's)
+        engine.obs = self.obs
         grid = engine.init_grid(initial=initial, seed=config.seed)
         # precompile the requested segment set (a no-op on a cache hit —
         # the signature pins the set, so the hit engine already has it)
@@ -428,12 +451,19 @@ class SessionManager:
         if self.store is None or session.spec is None:
             return
         try:
+            t0 = time.perf_counter()
             if grid_np is not None:
                 snap = recovery.encode_grid(grid_np)
                 snap["generation"] = session.generation
                 session.ckpt = snap
             self.store.save(session.id, session.spec, session.generation,
                             session.ckpt)
+            if self.obs is not None:
+                dt = time.perf_counter() - t0
+                self.obs.checkpoint_write.observe(dt)
+                self.obs.event("checkpoint_write", dt, t0, sid=session.id,
+                               generation=session.generation,
+                               snapshot=grid_np is not None)
         except Exception as e:  # noqa: BLE001 — durability is best-effort
             self.store_errors += 1
             print(f"note: state-dir write failed for {session.id}: "
@@ -510,6 +540,11 @@ class SessionManager:
                 session.grid = session.stepper(session.grid, n)
             session.generation = target_gen
         session.setup_s = time.perf_counter() - t0
+        if self.obs is not None:
+            self.obs.restore_replay.observe(session.setup_s)
+            self.obs.event("restore_replay", session.setup_s, t0,
+                           sid=rec["id"], replayed=n,
+                           backend=config.backend)
         session.spec = dict(rec["spec"])
         session.ckpt = snap
         session.restored = True
@@ -536,6 +571,10 @@ class SessionManager:
         if timeout:
             self.watchdog_timeouts += 1
         session.last_error = f"{type(err).__name__}: {err}"
+        if self.obs is not None:
+            self.obs.engine_failures.inc()
+            self.obs.event("engine_failure", sid=session.id,
+                           error=session.last_error, timeout=timeout)
         opened = self.cache.record_failure(sig)
         if opened:
             print(f"note: circuit breaker OPEN for plan of session "
@@ -577,6 +616,8 @@ class SessionManager:
             repl.id = session.id
             self._sessions[session.id] = repl
         session.closed = True           # orphan: late workers see closed
+        if self.obs is not None:
+            self.obs.event("degrade", sid=repl.id, reason=reason)
         print(f"note: session {repl.id} degraded to the serial_np oracle "
               f"({reason}); results stay bit-identical, throughput drops",
               file=sys.stderr)
@@ -661,35 +702,66 @@ class SessionManager:
             # batcher takes session.lock (leader-side) and falls back to
             # _step_locked when alone or on any batched-path failure
             return self.batcher.submit(self, session, steps)
-        with session.lock:
+        obs = self.obs
+        if obs is not None:
+            t0 = time.perf_counter()
+            session.lock.acquire()
+            wait = time.perf_counter() - t0
+            obs.lock_wait_series.observe(wait)
+            if wait >= 1e-3:
+                # only a *contended* wait is a trace-worthy fact; the
+                # uncontended acquire would just be ring noise
+                obs.event("lock_wait", wait, t0, sid=session.id)
+        else:
+            session.lock.acquire()
+        try:
             if session.closed:
                 raise KeyError(session.id)
             return self._step_locked(session, steps)
+        finally:
+            session.lock.release()
 
     def _step_locked(self, session: Session, steps: int) -> dict:
         """The solo step body; caller holds ``session.lock`` (the step
         path via :meth:`_step_entry`, the microbatch leader for
         lone/fallback entries)."""
+        obs = self.obs
         if session.engine is not None:
             import jax
 
             # a depth never seen before compiles here — that is setup,
             # not stepping; charge it to setup_s so throughput numbers
-            # stay honest (same accounting as run_tpu's phases)
+            # stay honest (same accounting as run_tpu's phases).  The
+            # engine itself records the compile event on a real miss, so
+            # the hot path adds no span around the dict hit.
             t0 = time.perf_counter()
             session.engine.ensure_compiled(session.grid, steps)
             t1 = time.perf_counter()
             session.setup_s += t1 - t0
             # step donates the input buffer: replace the reference
             grid = session.engine.step(session.grid, steps)
+            td = time.perf_counter() if obs is not None else 0.0
             jax.block_until_ready(grid)
             session.grid = grid
-            session.steady_s += time.perf_counter() - t1
+            t2 = time.perf_counter()
+            session.steady_s += t2 - t1
+            if obs is not None:
+                # ONE event for the dispatch+sync pair (block_s splits
+                # them at read time) through the pre-bound series — the
+                # whole per-step cost of observability is ~3 µs
+                obs.event("device_dispatch", t2 - t1, t1, sid=session.id,
+                          steps=steps, block_s=round(t2 - td, 9))
+                obs.dispatch_solo.observe(t2 - t1)
             self._mark_dispatch_ok()
         else:
             t0 = time.perf_counter()
             session.grid = session.stepper(session.grid, steps)
-            session.steady_s += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            session.steady_s += t1 - t0
+            if obs is not None:
+                obs.event("host_step", t1 - t0, t0,
+                          sid=session.id, steps=steps)
+                obs.dispatch_host.observe(t1 - t0)
         session.generation += steps
         self._checkpoint(session)
         return {"id": session.id, "generation": session.generation,
@@ -778,6 +850,10 @@ class SessionManager:
                 d["last_error"] = session.last_error
         return d
 
+    def _session_list(self):
+        with self._lock:
+            return list(self._sessions.values())
+
     def stats(self) -> dict:
         with self._lock:
             sessions = list(self._sessions.values())
@@ -803,6 +879,12 @@ class SessionManager:
             out["recovery"] = rec
         if self.faults is not None:
             out["faults"] = self.faults.stats()
+        if self.obs is not None:
+            from mpi_tpu.obs.profile import compile_execute_breakdown
+
+            obs_stats = self.obs.stats()
+            obs_stats["breakdown"] = compile_execute_breakdown(self)
+            out["obs"] = obs_stats
         return out
 
     def health(self) -> dict:

@@ -34,11 +34,18 @@ CSV_HEADER = (
 
 @dataclass
 class PhaseTimer:
-    """start() → [setup work] → setup_done() → [steady work] → finish()."""
+    """start() → [setup work] → setup_done() → [steady work] → finish().
+
+    ``span_sink``: optional ``callable(phase, t_start, dur_s)`` invoked
+    at ``finish()`` with the two phases ("setup", "steady") — the
+    adapter ``mpi_tpu.obs.Obs.phase_sink`` returns turns them into trace
+    events, so one-shot runs land in the same timeline as serve spans.
+    """
 
     t_begin: float = field(default_factory=time.perf_counter)
     t_setup_done: float = 0.0
     t_end: float = 0.0
+    span_sink: object = None
 
     def restart(self) -> None:
         self.t_begin = time.perf_counter()
@@ -50,6 +57,11 @@ class PhaseTimer:
         self.t_end = time.perf_counter()
         if self.t_setup_done == 0.0:
             self.t_setup_done = self.t_begin
+        if self.span_sink is not None:
+            self.span_sink("setup", self.t_begin,
+                           self.t_setup_done - self.t_begin)
+            self.span_sink("steady", self.t_setup_done,
+                           self.t_end - self.t_setup_done)
 
     @property
     def full_us(self) -> int:
@@ -147,6 +159,10 @@ def write_reports(
             f.write(f"Summed time: {total}us\n")
         f.write(f"Throughput: {timer.cells_per_sec(rows, cols, 1):.0f} cells/sec/iter-unit\n")
         f.write("___________________________________________________\n\n")
+        # a sweep dying mid-run must not lose rows already "written":
+        # same durability discipline as serve/recovery.py's StateStore
+        f.flush()
+        os.fsync(f.fileno())
     compact = os.path.join(out_dir, f"{time_file}_compact.csv")
     with open(compact, "a") as f:
         if first:
@@ -162,3 +178,5 @@ def write_reports(
         if extra:
             row += "".join(f",{v}" for v in extra.values())
         f.write(row + "\n")
+        f.flush()
+        os.fsync(f.fileno())

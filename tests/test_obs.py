@@ -1,0 +1,410 @@
+"""PR 4 observability: metrics exposition, trace JSONL, request-id
+propagation, the off-switch's bit-identity, and the smoke tool.
+
+The Prometheus parser/validators are imported from ``tools/obs_smoke.py``
+(one implementation, exercised both standalone and here) — the exposition
+format is API for scrapers, so these tests treat its shape as a contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+from obs_smoke import (  # noqa: E402 — tools/ has no package init
+    REQUIRED_METRICS, TRACE_KEYS, check_histograms, check_trace,
+    parse_prometheus,
+)
+
+from mpi_tpu.obs import Obs  # noqa: E402
+from mpi_tpu.obs.metrics import MetricsRegistry  # noqa: E402
+from mpi_tpu.obs.trace import (  # noqa: E402
+    Tracer, current_request_id, reset_request_id, set_request_id,
+)
+from mpi_tpu.serve.cache import EngineCache  # noqa: E402
+from mpi_tpu.serve.session import SessionManager  # noqa: E402
+from mpi_tpu.utils.timing import PhaseTimer, write_reports  # noqa: E402
+
+TPU_SPEC = {"rows": 64, "cols": 64, "backend": "tpu"}
+
+
+# --------------------------------------------------------------- metrics
+
+
+def test_registry_counter_gauge_histogram_render():
+    m = MetricsRegistry()
+    c = m.counter("t_total", "things")
+    c.inc(code=200)
+    c.inc(2, code=500)
+    g = m.gauge("t_gauge", "level")
+    g.set(3.5)
+    h = m.histogram("t_seconds", "durations", (0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 99.0):
+        h.observe(v)
+    types, samples = parse_prometheus(m.render())
+    assert types == {"t_total": "counter", "t_gauge": "gauge",
+                     "t_seconds": "histogram"}
+    vals = {(n, tuple(sorted(lb.items()))): v for n, lb, v in samples}
+    assert vals[("t_total", (("code", "200"),))] == 1
+    assert vals[("t_total", (("code", "500"),))] == 2
+    assert vals[("t_gauge", ())] == 3.5
+    # le semantics: a value equal to a bound lands in that bound's bucket
+    assert vals[("t_seconds_bucket", (("le", "0.1"),))] == 2
+    assert vals[("t_seconds_bucket", (("le", "1"),))] == 3
+    assert vals[("t_seconds_bucket", (("le", "+Inf"),))] == 5
+    assert vals[("t_seconds_count", ())] == 5
+    check_histograms(types, samples)
+
+
+def test_bound_series_matches_labeled_observe():
+    m = MetricsRegistry()
+    h = m.histogram("b_seconds", "x", (1.0, 2.0))
+    bound = h.series(mode="solo")
+    bound.observe(0.5)
+    h.observe(1.5, mode="solo")
+    assert h.count(mode="solo") == 2
+    types, samples = parse_prometheus(m.render())
+    check_histograms(types, samples)
+
+
+def test_histogram_buckets_monotone_under_load():
+    m = MetricsRegistry()
+    h = m.histogram("load_seconds", "x")
+    rng = np.random.default_rng(7)
+    for v in rng.exponential(0.05, size=500):
+        h.observe(float(v))
+    types, samples = parse_prometheus(m.render())
+    check_histograms(types, samples)
+
+
+def test_registry_rebind_is_idempotent_and_fn_metrics_replace():
+    m = MetricsRegistry()
+    c1 = m.counter("same_total", "x")
+    c1.inc()
+    assert m.counter("same_total", "x") is c1       # kind match → existing
+    m.gauge_fn("live", "x", lambda: 1)
+    m.gauge_fn("live", "x", lambda: 2)              # callbacks re-bind
+    assert "live 2" in m.render()
+    m.gauge_fn("boom", "x", lambda: 1 / 0)          # sick provider
+    assert "boom" not in m.render()                 # scrape survives
+
+
+# ----------------------------------------------------------------- trace
+
+
+def test_trace_jsonl_round_trip(tmp_path):
+    log = tmp_path / "trace.jsonl"
+    tr = Tracer(capacity=16, log_path=str(log))
+    token = set_request_id(42)
+    try:
+        with tr.span("outer", sid="s1") as sp:
+            sp.tag(code=200)
+        tr.event("evt", 0.25, steps=3)
+    finally:
+        reset_request_id(token)
+    tr.event("no_rid")
+    tr.close()
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert [r["name"] for r in recs] == ["outer", "evt", "no_rid"]
+    for r in recs:
+        assert TRACE_KEYS <= r.keys()
+    assert recs[0]["rid"] == 42 and recs[0]["code"] == 200
+    assert recs[0]["sid"] == "s1"
+    assert recs[1]["rid"] == 42 and recs[1]["dur_s"] == 0.25
+    assert "rid" not in recs[2]
+    # the ring holds the same records the stream got
+    assert [r["name"] for r in tr.snapshot()] == ["outer", "evt", "no_rid"]
+
+
+def test_trace_ring_overwrites_and_dump(tmp_path):
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        tr.event(f"e{i}")
+    snap = tr.snapshot()
+    assert [r["name"] for r in snap] == ["e6", "e7", "e8", "e9"]
+    st = tr.stats()
+    assert st["recorded"] == 10 and st["dropped"] == 6
+    out = tmp_path / "dump.jsonl"
+    tr.dump(str(out))
+    dumped = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["name"] for r in dumped] == ["e6", "e7", "e8", "e9"]
+
+
+def test_span_records_error_and_reraises():
+    tr = Tracer(capacity=8)
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("nope")
+    rec = tr.snapshot()[-1]
+    assert rec["name"] == "boom" and "ValueError" in rec["error"]
+
+
+# --------------------------------------------- manager + engine coverage
+
+
+def _density(mgr, sid):
+    snap = mgr.snapshot(sid)
+    return sum(row.count("1") for row in snap["grid"])
+
+
+def test_no_obs_is_bit_identical():
+    """obs=None must take the exact pre-PR-4 code path: same grids,
+    generation for generation."""
+    base = SessionManager(EngineCache(max_size=4), obs=None)
+    inst = SessionManager(EngineCache(max_size=4), obs=Obs())
+    spec = dict(TPU_SPEC, seed=13)
+    a = base.create(dict(spec))["id"]
+    b = inst.create(dict(spec))["id"]
+    for steps in (1, 3, 1):
+        base.step(a, steps)
+        inst.step(b, steps)
+    ga = base.snapshot(a)["grid"]
+    gb = inst.snapshot(b)["grid"]
+    assert ga == gb
+
+
+def test_engine_compile_and_dispatch_metrics():
+    obs = Obs()
+    mgr = SessionManager(EngineCache(max_size=4), obs=obs)
+    sid = mgr.create(dict(TPU_SPEC))["id"]
+    mgr.step(sid, 1)
+    mgr.step(sid, 1)        # warm: no recompile
+    types, samples = parse_prometheus(obs.render_metrics())
+    vals = {(n, tuple(sorted(lb.items()))): v for n, lb, v in samples}
+    assert vals[("mpi_tpu_engine_counters_total",
+                 (("kind", "compiles"),))] >= 1
+    assert vals[("mpi_tpu_engine_counters_total",
+                 (("kind", "step_calls"),))] == 2
+    assert vals[("mpi_tpu_dispatch_latency_seconds_count",
+                 (("mode", "solo"),))] == 2
+    assert vals[("mpi_tpu_compile_wall_seconds_count", ())] >= 1
+    # the trace saw the compile and both dispatches
+    names = [r["name"] for r in obs.tracer.snapshot()]
+    assert "compile" in names and names.count("device_dispatch") == 2
+    # one real compile: the second step must not re-emit a compile event
+    assert names.count("compile") == sum(
+        e.compile_count for e in mgr.cache.engines())
+
+
+def test_counters_survive_breaker_open_and_degrade_cycle():
+    """ISSUE 3's breaker scenario under instrumentation: injected step
+    faults trip the breaker and degrade the session; every counter keeps
+    counting and the scrape stays parseable throughout."""
+    cache = EngineCache(max_size=4, breaker_threshold=3,
+                        breaker_cooldown_s=60.0)
+    obs = Obs()
+    mgr = SessionManager(cache, obs=obs, step_retries=2,
+                         retry_backoff_s=0.001, faults="step:1-3:raise")
+    sid = mgr.create(dict(TPU_SPEC))["id"]
+    r = mgr.step(sid, 1)            # 3 failures → breaker opens → degrade
+    assert r["generation"] == 1 and mgr.get(sid).degraded
+    mgr.step(sid, 2)                # serial_np fallback keeps serving
+    types, samples = parse_prometheus(obs.render_metrics())
+    vals = {(n, tuple(sorted(lb.items()))): v for n, lb, v in samples}
+    assert vals[("mpi_tpu_engine_failures_total", ())] == 3
+    assert vals[("mpi_tpu_engine_failures_observed_total", ())] == 3
+    assert vals[("mpi_tpu_breaker_trips_total", ())] == 1
+    assert vals[("mpi_tpu_breaker_signatures", (("state", "open"),))] == 1
+    assert vals[("mpi_tpu_degraded_sessions", ())] == 1
+    assert vals[("mpi_tpu_degraded_sessions_total", ())] == 1
+    # degraded steps dispatch on the host path
+    assert vals[("mpi_tpu_dispatch_latency_seconds_count",
+                 (("mode", "host"),))] >= 1
+    check_histograms(types, samples)
+    names = [r["name"] for r in obs.tracer.snapshot()]
+    assert "engine_failure" in names and "degrade" in names
+
+
+def test_checkpoint_and_restore_metrics(tmp_path):
+    obs = Obs()
+    mgr = SessionManager(EngineCache(max_size=4), obs=obs,
+                         state_dir=str(tmp_path), checkpoint_every=1)
+    sid = mgr.create(dict(TPU_SPEC, seed=5))["id"]
+    mgr.step(sid, 2)
+    assert obs.checkpoint_write.count() >= 1
+    # a second manager restores from disk under its own obs
+    obs2 = Obs()
+    mgr2 = SessionManager(EngineCache(max_size=4), obs=obs2,
+                          state_dir=str(tmp_path))
+    assert mgr2.snapshot(sid)["grid"] == mgr.snapshot(sid)["grid"]
+    assert obs2.restore_replay.count() == 1
+    assert any(r["name"] == "restore_replay"
+               for r in obs2.tracer.snapshot())
+
+
+def test_request_id_flows_from_contextvar_to_spans():
+    obs = Obs()
+    mgr = SessionManager(EngineCache(max_size=4), obs=obs)
+    sid = mgr.create(dict(TPU_SPEC))["id"]
+    assert current_request_id() is None
+    token = set_request_id(99)
+    try:
+        mgr.step(sid, 1)
+    finally:
+        reset_request_id(token)
+    dispatches = [r for r in obs.tracer.snapshot()
+                  if r["name"] == "device_dispatch"]
+    assert dispatches and dispatches[-1]["rid"] == 99
+
+
+# ------------------------------------------------------------------ HTTP
+
+
+@pytest.fixture()
+def obs_server(tmp_path):
+    from mpi_tpu.serve.httpd import make_server
+
+    trace_log = tmp_path / "trace.jsonl"
+    obs = Obs(trace_log=str(trace_log))
+    mgr = SessionManager(EngineCache(max_size=4), obs=obs)
+    srv = make_server(port=0, manager=mgr,
+                      profile_dir=str(tmp_path / "prof"))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv, obs, trace_log
+    srv.shutdown()
+    srv.server_close()
+    obs.close()
+    thread.join(timeout=5)
+
+
+def _req(srv, method, path, body=None, raw=False):
+    host, port = srv.server_address[:2]
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = resp.read()
+            return resp.status, (payload.decode() if raw
+                                 else json.loads(payload))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_metrics_endpoint_and_trace_linkage(obs_server):
+    srv, obs, trace_log = obs_server
+    _, created = _req(srv, "POST", "/sessions", dict(TPU_SPEC))
+    sid = created["id"]
+    for _ in range(2):
+        _req(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+    status, text = _req(srv, "GET", "/metrics", raw=True)
+    assert status == 200
+    types, samples = parse_prometheus(text)
+    missing = [m for m in REQUIRED_METRICS if m not in types]
+    assert not missing, f"/metrics missing families: {missing}"
+    check_histograms(types, samples)
+    vals = {(n, tuple(sorted(lb.items()))): v for n, lb, v in samples}
+    # >= 2, not == 3: the counter increments after the response bytes are
+    # written, so a fast scrape on a fresh connection can race the
+    # increment of the request that just answered
+    assert vals[("mpi_tpu_http_requests_total",
+                 (("code", "200"), ("method", "POST")))] >= 2
+    # stats folds the obs section in
+    _, stats = _req(srv, "GET", "/stats")
+    assert stats["obs"]["trace"]["recorded"] > 0
+    assert stats["obs"]["breakdown"]["regime"] in (
+        "idle", "compile-bound", "dispatch-bound", "compute-bound")
+    obs.close()     # flush the stream before reading it back
+    n_recs, n_linked = check_trace(str(trace_log))
+    assert n_recs > 0 and n_linked >= 2
+
+
+def test_metrics_404_when_obs_disabled():
+    from mpi_tpu.serve.httpd import make_server
+
+    srv = make_server(port=0, manager=SessionManager(
+        EngineCache(max_size=4), obs=None))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        status, body = _req(srv, "GET", "/metrics")
+        assert status == 404 and "--no-obs" in body["error"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        thread.join(timeout=5)
+
+
+def test_profile_endpoint(obs_server):
+    srv, _, _ = obs_server
+    # armed via the fixture's profile_dir; jax.profiler works on CPU
+    status, body = _req(srv, "POST", "/debug/profile?secs=0.05")
+    # tolerant: a capture can fail in constrained sandboxes, but the
+    # route must answer structured JSON either way
+    assert status in (200, 503) and "ok" in body
+    status, body = _req(srv, "POST", "/debug/profile?secs=nope")
+    assert status == 400
+
+
+def test_profile_404_when_unarmed():
+    from mpi_tpu.serve.httpd import make_server
+
+    srv = make_server(port=0, manager=SessionManager(
+        EngineCache(max_size=4), obs=Obs()))
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        status, body = _req(srv, "POST", "/debug/profile")
+        assert status == 404 and "--profile-dir" in body["error"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------- timing
+
+
+def test_phase_timer_span_sink():
+    calls = []
+    t = PhaseTimer(span_sink=lambda phase, t0, d: calls.append(
+        (phase, t0, d)))
+    t.setup_done()
+    t.finish()
+    assert [c[0] for c in calls] == ["setup", "steady"]
+    assert all(d >= 0.0 for _, _, d in calls)
+    # Obs.phase_sink lands the phases in the trace timeline
+    obs = Obs()
+    t2 = PhaseTimer(span_sink=obs.phase_sink())
+    t2.setup_done()
+    t2.finish()
+    assert [r["name"] for r in obs.tracer.snapshot()] == [
+        "phase:setup", "phase:steady"]
+
+
+def test_write_reports_fsyncs_before_close(tmp_path, monkeypatch):
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(fd),
+                                                 real_fsync(fd))[1])
+    t = PhaseTimer(t_begin=0.0)
+    t.t_setup_done, t.t_end = 0.4, 1.0
+    write_reports("obs_t", t, 8, 8, processes=1, first=True,
+                  out_dir=str(tmp_path))
+    # both report files (detailed + compact) fsync before close
+    assert len(synced) == 2
+
+
+# ------------------------------------------------------------ smoke tool
+
+
+def test_obs_smoke_tool_subprocess():
+    """The standalone schema-drift gate passes against the current tree."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "obs_smoke.py")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "obs smoke OK" in proc.stdout

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""End-to-end observability smoke: serve on XLA:CPU, drive ~20 mixed
+requests, then scrape ``GET /metrics`` and the ``--trace-log`` JSONL and
+fail LOUDLY (exit 1) on any schema drift — missing metric families,
+non-monotone histogram buckets, malformed trace records, or a request
+whose lifecycle cannot be reconstructed by its shared request id.
+
+This is the contract check for PR 4's tentpole: dashboards and trace
+tooling parse these two text formats, so their shape is API.  Run
+directly (``python tools/obs_smoke.py``) or via the tier-1 wrapper in
+``tests/test_obs.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import urllib.request
+
+# the metric families every scrape must expose (pre-registered or bound
+# at manager attach — present even before traffic touches a site)
+REQUIRED_METRICS = [
+    "mpi_tpu_dispatch_latency_seconds",
+    "mpi_tpu_batch_occupancy_boards",
+    "mpi_tpu_compile_wall_seconds",
+    "mpi_tpu_checkpoint_write_seconds",
+    "mpi_tpu_restore_replay_seconds",
+    "mpi_tpu_session_lock_wait_seconds",
+    "mpi_tpu_http_requests_total",
+    "mpi_tpu_sessions",
+    "mpi_tpu_breaker_signatures",
+    "mpi_tpu_cache_events_total",
+    "mpi_tpu_engine_counters_total",
+    "mpi_tpu_batch_queue_depth",
+    "mpi_tpu_trace_spans_total",
+]
+# every trace record must carry exactly these core keys
+TRACE_KEYS = {"seq", "name", "t_unix", "t_mono", "dur_s", "thread"}
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?'
+    r'\s+(?P<value>[^ ]+)$')
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format parser: returns (types, samples) where
+    samples is [(name, {label: value}, float)].  Raises on any line that
+    is neither a comment nor a well-formed sample."""
+    types, samples = {}, []
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"/metrics line {ln} is not a sample: {line!r}")
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return types, samples
+
+
+def check_histograms(types, samples):
+    """Cumulative ``_bucket`` series must be monotone nondecreasing in
+    ``le`` and end at ``+Inf`` == ``_count``."""
+    series = {}
+    for name, labels, value in samples:
+        if not name.endswith("_bucket"):
+            continue
+        base = name[: -len("_bucket")]
+        key = (base, tuple(sorted((k, v) for k, v in labels.items()
+                                  if k != "le")))
+        series.setdefault(key, []).append((labels["le"], value))
+    counts = {(n[: -len("_count")],
+               tuple(sorted(labels.items()))): v
+              for n, labels, v in samples if n.endswith("_count")}
+    if not series:
+        raise ValueError("no histogram _bucket series rendered at all")
+    for (base, lk), buckets in series.items():
+        if types.get(base) != "histogram":
+            raise ValueError(f"{base} has _bucket series but TYPE "
+                             f"{types.get(base)!r}")
+        ordered = sorted(
+            buckets, key=lambda b: float("inf") if b[0] == "+Inf"
+            else float(b[0]))
+        vals = [v for _, v in ordered]
+        if vals != sorted(vals):
+            raise ValueError(f"{base}{dict(lk)} buckets not monotone: {vals}")
+        if ordered[-1][0] != "+Inf":
+            raise ValueError(f"{base}{dict(lk)} missing +Inf bucket")
+        if counts.get((base, lk)) != vals[-1]:
+            raise ValueError(
+                f"{base}{dict(lk)} +Inf ({vals[-1]}) != _count "
+                f"({counts.get((base, lk))})")
+
+
+def check_trace(path):
+    """Every JSONL record well-formed; at least one http_request span
+    shares its rid with a dispatch span (lifecycle reconstructable)."""
+    recs = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            rec = json.loads(line)
+            missing = TRACE_KEYS - rec.keys()
+            if missing:
+                raise ValueError(f"trace line {ln} missing {sorted(missing)}:"
+                                 f" {rec}")
+            recs.append(rec)
+    seqs = [r["seq"] for r in recs]
+    if sorted(seqs) != seqs:
+        raise ValueError("trace seq numbers not monotone in stream order")
+    by_rid = {}
+    for r in recs:
+        if "rid" in r:
+            by_rid.setdefault(r["rid"], set()).add(r["name"])
+    linked = [rid for rid, names in by_rid.items()
+              if "http_request" in names
+              and (names & {"device_dispatch", "batched_dispatch",
+                            "host_step"})]
+    if not linked:
+        raise ValueError(
+            "no request id links an http_request span to a dispatch span; "
+            f"rids seen: { {k: sorted(v) for k, v in by_rid.items()} }")
+    return len(recs), len(linked)
+
+
+def main():
+    from mpi_tpu.obs import Obs
+    from mpi_tpu.serve.cache import EngineCache
+    from mpi_tpu.serve.httpd import make_server
+    from mpi_tpu.serve.session import SessionManager
+
+    workdir = tempfile.mkdtemp(prefix="mpi_tpu_obs_smoke_")
+    trace_log = os.path.join(workdir, "trace.jsonl")
+    obs = Obs(trace_capacity=4096, trace_log=trace_log)
+    manager = SessionManager(EngineCache(max_size=4), obs=obs,
+                             batch_window_ms=2.0,
+                             state_dir=os.path.join(workdir, "state"),
+                             checkpoint_every=1)
+    server = make_server(port=0, manager=manager)
+    host, port = server.server_address[:2]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://{host}:{port}"
+
+    def call(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+
+    try:
+        # ~20 mixed requests: creates (incl. an engine-cache hit and a
+        # serial backend), concurrent same-signature steps (coalesced
+        # into a batched dispatch), reads, a delete, the info routes
+        _, body = call("POST", "/sessions",
+                       {"rows": 64, "cols": 64, "backend": "tpu"})
+        sid_a = json.loads(body)["id"]
+        _, body = call("POST", "/sessions",
+                       {"rows": 64, "cols": 64, "backend": "tpu"})
+        sid_b = json.loads(body)["id"]
+        _, body = call("POST", "/sessions",
+                       {"rows": 16, "cols": 16, "backend": "serial"})
+        sid_c = json.loads(body)["id"]
+        errs = []
+
+        def step(sid):
+            try:
+                code, _ = call("POST", f"/sessions/{sid}/step", {"steps": 1})
+                assert code == 200
+            except Exception as e:  # noqa: BLE001 — collected below
+                errs.append(e)
+
+        for _ in range(3):      # concurrent same-signature pairs → batched
+            ts = [threading.Thread(target=step, args=(s,))
+                  for s in (sid_a, sid_b)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        if errs:
+            raise errs[0]
+        step(sid_a)             # solo dispatch
+        step(sid_c)             # host-path dispatch
+        call("GET", f"/sessions/{sid_a}/snapshot")
+        call("GET", f"/sessions/{sid_a}/density")
+        call("GET", f"/sessions/{sid_b}/snapshot")
+        call("GET", f"/sessions/{sid_b}/density")
+        call("GET", "/healthz")
+        call("GET", "/stats")
+        call("DELETE", f"/sessions/{sid_c}")
+
+        code, text = call("GET", "/metrics")   # request 19; the counter
+        assert code == 200, f"/metrics -> {code}"  # increments post-render
+        types, samples = parse_prometheus(text)
+        # family presence from the TYPE lines — the registry emits them
+        # even for a histogram no traffic has touched yet
+        missing = [m for m in REQUIRED_METRICS if m not in types]
+        if missing:
+            raise ValueError(f"/metrics missing families: {missing}")
+        check_histograms(types, samples)
+        http_total = sum(v for n, _, v in samples
+                         if n == "mpi_tpu_http_requests_total")
+        # 18 requests precede the scrape, but the counter increments
+        # after the response bytes go out, so the scrape may race the
+        # increment of the request answered just before it
+        if http_total < 17:
+            raise ValueError(f"expected >= 17 http requests counted, "
+                             f"got {http_total}")
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()
+
+    n_recs, n_linked = check_trace(trace_log)
+    print(f"obs smoke OK: {len(samples)} metric samples, "
+          f"{n_recs} trace records, {n_linked} request lifecycles linked "
+          f"({trace_log})")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 — nonzero exit IS the contract
+        print(f"obs smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(1)

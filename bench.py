@@ -1057,6 +1057,161 @@ def serve_bench_obs() -> None:
     print(json.dumps(out))
 
 
+def serve_bench_async() -> None:
+    """`python bench.py --serve-async`: the async-pipelining A/B.
+
+    Three modes on the same dispatch-bound 64x64 signature with 8
+    concurrent sessions whose depths cycle {1, 2, 5}:
+
+    * **sync mixed** — blocking steps through the MicroBatcher, which
+      keys on (signature, depth): only the same-depth subsets coalesce,
+      so a mixed-depth population fragments into narrow dispatches.
+    * **async uniform** — tickets, all depth 2 (the dispatch loop's
+      best case: every round is a full-width stacked chain).
+    * **async mixed** — the tentpole case: the SAME mixed depths, but
+      decomposed into unit rounds so all 8 boards share stacked
+      dispatches until they individually finish.
+
+    Reports per-mode throughput (generations/s), the dispatch loop's
+    mean batch occupancy, client-side p50/p99 ticket latency, and the
+    speedup of async mixed over sync mixed (the acceptance gate is
+    >= 1.3x in the dispatch-bound regime).  Also times a single
+    blocking client with async enabled vs `--no-async` — the dispatch
+    loop must idle for free (<= 5% regression).  One JSON line.
+    """
+    out = {"bench": "serve_async", "ok": False}
+    try:
+        import threading
+
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.session import SessionManager
+
+        spec = {"rows": 64, "cols": 64, "backend": "tpu",
+                "boundary": "periodic"}
+        nsess = 8
+        depths = [(1, 2, 5)[i % 3] for i in range(nsess)]
+        rounds = 8
+
+        def pctl(xs, q):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+        def run_sync(mgr, sids, per_depth):
+            # the blocking client model: one persistent thread per
+            # session, each looping its rounds of blocking steps (no
+            # global barrier — same total workload as run_async)
+            errs = []
+
+            def go(sid, d):
+                try:
+                    for _ in range(rounds):
+                        mgr.step(sid, d)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=go, args=(s, d))
+                  for s, d in zip(sids, per_depth)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise errs[0]
+            return {"gens": rounds * sum(per_depth), "wall_s": wall}
+
+        def run_async(mgr, sids, per_depth):
+            # the async client model: enqueue the whole workload without
+            # blocking (round-major, so the per-session queues stay
+            # balanced) and harvest results afterwards — the dispatch
+            # loop runs back-to-back stacked rounds from its queues, and
+            # an early-finishing board's NEXT ticket becomes its queue
+            # head immediately, keeping occupancy at the concurrency
+            # bound instead of the depth-agreement bound
+            lat, burst = [], []
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                for sid, d in zip(sids, per_depth):
+                    ts = time.perf_counter()
+                    burst.append(
+                        (mgr.step_async(sid, d)["ticket"], ts))
+            for tid, ts in burst:
+                mgr.ticket_result(tid, wait=True)
+                lat.append(time.perf_counter() - ts)
+            wall = time.perf_counter() - t0
+            return {"gens": rounds * sum(per_depth), "wall_s": wall,
+                    "lat": lat}
+
+        def summarize(r, st=None):
+            s = {"generations": r["gens"],
+                 "wall_s": round(r["wall_s"], 4),
+                 "gens_per_s": round(r["gens"] / r["wall_s"], 2)}
+            if "lat" in r:
+                s["ticket_p50_ms"] = round(pctl(r["lat"], 0.50) * 1e3, 3)
+                s["ticket_p99_ms"] = round(pctl(r["lat"], 0.99) * 1e3, 3)
+            if st is not None:
+                s["mean_occupancy"] = st["avg_occupancy"]
+                s["unit_rounds"] = st["unit_rounds"]
+            return s
+
+        # one manager per mode keeps the modes' counters clean while the
+        # EngineCache (and its compiles) is shared across them
+        cache = EngineCache(max_size=4)
+        modes = {}
+
+        mgr = SessionManager(cache, batch_window_ms=2.0, batch_max=nsess)
+        sids = [mgr.create(dict(spec, seed=s))["id"] for s in range(nsess)]
+        run_sync(mgr, sids, depths)             # warm every (depth, B)
+        modes["sync_mixed"] = summarize(run_sync(mgr, sids, depths))
+
+        mgr = SessionManager(cache, batch_window_ms=2.0, batch_max=nsess)
+        sids = [mgr.create(dict(spec, seed=s))["id"] for s in range(nsess)]
+        run_async(mgr, sids, [2] * nsess)       # warm the [B,...] chain
+        mgr.dispatcher.reset_stats()
+        modes["async_uniform"] = summarize(
+            run_async(mgr, sids, [2] * nsess), mgr.dispatcher.stats())
+
+        mgr = SessionManager(cache, batch_window_ms=2.0, batch_max=nsess)
+        sids = [mgr.create(dict(spec, seed=s))["id"] for s in range(nsess)]
+        run_async(mgr, sids, depths)
+        mgr.dispatcher.reset_stats()
+        modes["async_mixed"] = summarize(
+            run_async(mgr, sids, depths), mgr.dispatcher.stats())
+
+        # single blocking client, async loop idle vs absent: the loop
+        # must cost nothing when unused
+        def solo_mean_ms(async_enabled):
+            m = SessionManager(cache, async_enabled=async_enabled)
+            sid = m.create(dict(spec, seed=99))["id"]
+            m.step(sid, 1)                      # warm
+            best = float("inf")
+            for _ in range(3):                  # min-of-3: scheduler-noise
+                t0 = time.perf_counter()        # robust on a busy CPU host
+                n = 30
+                for _ in range(n):
+                    m.step(sid, 1)
+                best = min(best, (time.perf_counter() - t0) / n * 1e3)
+            return best
+
+        with_async = solo_mean_ms(True)
+        without = solo_mean_ms(False)
+        out.update(
+            ok=True, sessions=nsess, depths=depths, rounds=rounds,
+            modes=modes,
+            async_mixed_speedup=round(
+                modes["async_mixed"]["gens_per_s"]
+                / modes["sync_mixed"]["gens_per_s"], 3),
+            solo_ms_async_on=round(with_async, 4),
+            solo_ms_async_off=round(without, 4),
+            solo_regression_pct=round(
+                (with_async - without) / without * 100, 2),
+        )
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         probe()
@@ -1064,6 +1219,8 @@ if __name__ == "__main__":
         serve_bench()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-batched":
         serve_bench_batched()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-async":
+        serve_bench_async()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-recovery":
         serve_bench_recovery()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-obs":

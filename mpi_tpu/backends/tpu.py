@@ -586,6 +586,18 @@ class Engine:
         self.step_calls += 1
         return c(grid)
 
+    def step_units(self, grid, n: int):
+        """Advance ``grid`` by ``n`` generations as n chained depth-1
+        dispatches with NO intermediate sync: each link donates the
+        previous link's output, so JAX's async dispatch keeps the device
+        pipeline full while only ever needing the depth-1 executable —
+        the one depth every serve session precompiles.  Callers sync
+        (``jax.block_until_ready``) when they need the result; like
+        :meth:`step`, the input buffer is donated."""
+        for _ in range(max(0, int(n))):
+            grid = self.step(grid, 1)
+        return grid
+
     # -- batched stepping (vmapped multi-board serving hot path) ----------
 
     def batched_sharding(self):
@@ -642,6 +654,15 @@ class Engine:
             self.fault_hook("batched")
         self.batched_step_calls += 1
         return c(grids)
+
+    def step_batched_units(self, grids, n: int):
+        """Batched analog of :meth:`step_units`: n chained depth-1
+        batched dispatches, each donating the previous stacked batch,
+        with no intermediate sync — the async dispatcher's unit-round
+        chain for a batch whose composition holds for n rounds."""
+        for _ in range(max(0, int(n))):
+            grids = self.step_batched(grids, 1)
+        return grids
 
     def batched_stepper(self, B: int):
         """A ``step(grids, n)`` callable pinned to batch width ``B`` — the

@@ -190,6 +190,25 @@ class Obs:
                        "Step requests waiting in coalescing queues",
                        manager.batcher.queue_depth)
 
+        dispatcher = getattr(manager, "dispatcher", None)
+        if dispatcher is not None:
+            # scrape-time callbacks over the dispatcher's authoritative
+            # queue state — same no-shadow-counting rule as everything
+            # else here; values match /stats' "async" section exactly
+            m.gauge_fn("mpi_tpu_ticket_queue_depth",
+                       "Async tickets waiting for the dispatch loop",
+                       dispatcher.queue_depth)
+            m.gauge_fn("mpi_tpu_tickets_pending",
+                       "Async tickets enqueued but not yet resolved",
+                       dispatcher.pending)
+            m.counter_fn("mpi_tpu_tickets_completed_total",
+                         "Async tickets resolved (done or error)",
+                         lambda: dispatcher.tickets_completed)
+            m.counter_fn("mpi_tpu_unit_rounds_total",
+                         "Depth-1 device rounds executed by the dispatch "
+                         "loop (chained, one sync per chain)",
+                         lambda: dispatcher.unit_rounds)
+
         def _cells_per_sec():
             out = []
             for s in manager._session_list():

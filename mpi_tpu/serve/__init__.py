@@ -23,6 +23,14 @@ that degrades sick engines to the bit-identical ``serial_np`` oracle,
 and deterministic fault injection (``serve/faults.py``) to drive every
 recovery path under test.
 
+Async ticketed stepping (PR 5, ``serve/ticket.py``) decouples HTTP from
+device submission: ``POST /step`` with ``{"async": true}`` returns a
+ticket immediately and a per-manager dispatch loop owns the device,
+decomposing depth-k tickets into unit steps so mixed-depth sessions
+share stacked dispatches (occupancy bounded by concurrency, not depth
+agreement).  Tickets carry the full deadline/breaker/watchdog
+semantics; the sync path is untouched.
+
 Observability (PR 4, ``mpi_tpu.obs``) threads through every layer as an
 optional :class:`~mpi_tpu.obs.Obs` handle (``SessionManager(obs=...)``):
 request-id-tagged trace spans, Prometheus-text ``GET /metrics``, and
@@ -40,10 +48,12 @@ from mpi_tpu.serve.session import (
     EngineUnavailableError,
     SessionManager,
 )
+from mpi_tpu.serve.ticket import AsyncDispatcher, Ticket, TicketQueueFullError
 from mpi_tpu.serve.httpd import make_server
 
 __all__ = [
     "EngineCache", "MicroBatcher", "SessionManager", "make_server",
     "StateStore", "FaultInjector", "FaultPlan", "InjectedFault",
     "DeadlineError", "EngineStepError", "EngineUnavailableError",
+    "AsyncDispatcher", "Ticket", "TicketQueueFullError",
 ]

@@ -50,6 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max boards per stacked batched dispatch")
     p.add_argument("--no-batch", action="store_true",
                    help="disable microbatching; every step dispatches solo")
+    p.add_argument("--no-async", action="store_true",
+                   help="disable ticketed async stepping: POST /step with "
+                   "async=1 answers 400 and no dispatch loop runs (async "
+                   "is opt-in per request either way; the sync path is "
+                   "identical with or without this flag)")
+    p.add_argument("--async-queue-max", type=int, default=1024,
+                   help="bound on tickets queued for the async dispatch "
+                   "loop; an enqueue beyond it answers a structured 503 "
+                   "(backpressure, not an error)")
     p.add_argument("--verbose", action="store_true",
                    help="log one line per HTTP request (with request ids)")
     p.add_argument("--state-dir", default=None,
@@ -120,6 +129,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             batching=not args.no_batch,
             batch_window_ms=args.batch_window_ms,
             batch_max=args.batch_max,
+            async_enabled=not args.no_async,
+            async_queue_max=args.async_queue_max,
             state_dir=args.state_dir,
             checkpoint_every=args.checkpoint_every,
             request_timeout_s=args.request_timeout_s,
@@ -138,6 +149,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     batch = ("off" if args.no_batch else
              f"window {args.batch_window_ms}ms max {args.batch_max}")
     extras = []
+    if args.no_async:
+        extras.append("async off")
     if args.state_dir:
         extras.append(f"state-dir {args.state_dir}")
         if manager.restored_sessions:

@@ -3,7 +3,16 @@
 Routes (all bodies and responses are JSON):
 
     POST   /sessions                   create a board (spec in body)
-    POST   /sessions/<id>/step         advance; body {"steps": k}, default 1
+    POST   /sessions/<id>/step         advance; body {"steps": k}, default 1.
+                                       {"async": true} in the body (or
+                                       ?async=1) enqueues instead and
+                                       answers {"ticket": ..., "status":
+                                       "pending"} immediately
+    GET    /result/<ticket>            the ticket's outcome: pending, done
+                                       (with the step result), or the SAME
+                                       structured 503/404 the blocking path
+                                       would have answered; ?wait=1 blocks
+                                       until resolution (request budget)
     GET    /sessions/<id>/snapshot     full grid as '0'/'1' row strings
     GET    /sessions/<id>/density      live-cell count / density
     DELETE /sessions/<id>              close the board
@@ -64,6 +73,7 @@ from mpi_tpu.config import ConfigError
 from mpi_tpu.obs.trace import reset_request_id, set_request_id
 from mpi_tpu.serve.session import (
     DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
+    TicketQueueFullError,
 )
 
 
@@ -124,6 +134,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (TypeError, ValueError):
             raise ConfigError(f"timeout_s must be a number, got {raw!r}")
 
+    def _query_flag(self, name: str) -> bool:
+        """A boolean query parameter (``?async=1``, ``?wait=true``)."""
+        qs = parse_qs(urlsplit(self.path).query)
+        return (qs.get(name, ["0"])[0].lower() in ("1", "true", "yes"))
+
     def _route(self) -> Tuple[str, Optional[str], Optional[str]]:
         """(kind, session_id, verb) from the path."""
         parts = [p for p in self.path.split("?")[0].split("/") if p]
@@ -135,6 +150,8 @@ class _Handler(BaseHTTPRequestHandler):
             return "metrics", None, None
         if parts == ["debug", "profile"]:
             return "profile", None, None
+        if len(parts) == 2 and parts[0] == "result":
+            return "result", parts[1], None     # parts[1] is the ticket id
         if parts and parts[0] == "sessions":
             if len(parts) == 1:
                 return "sessions", None, None
@@ -187,6 +204,10 @@ class _Handler(BaseHTTPRequestHandler):
                 body = self._body()
                 timeout_s = self._timeout_override(body)
                 return self._reply(200, mgr.create(body, timeout_s=timeout_s))
+            if kind == "result" and method == "GET" and sid is not None:
+                return self._reply(200, mgr.ticket_result(
+                    sid, wait=self._query_flag("wait"),
+                    timeout_s=self._timeout_override({})))
             if kind == "session" and sid is not None:
                 if method == "POST" and verb == "step":
                     body = self._body()
@@ -194,6 +215,9 @@ class _Handler(BaseHTTPRequestHandler):
                     steps = body.get("steps", 1)
                     if not isinstance(steps, int):
                         raise ConfigError(f"steps must be an int, got {steps!r}")
+                    if self._query_flag("async") or bool(body.get("async")):
+                        return self._reply(200, mgr.step_async(
+                            sid, steps, timeout_s=timeout_s))
                     return self._reply(
                         200, mgr.step(sid, steps, timeout_s=timeout_s))
                 if method == "GET" and verb == "snapshot":
@@ -207,8 +231,10 @@ class _Handler(BaseHTTPRequestHandler):
                         sid, timeout_s=self._timeout_override({})))
             return self._reply(404, {"error": f"no route {method} {self.path}"})
         except KeyError:
-            return self._reply(404, {"error": f"no session {sid!r}"})
-        except (DeadlineError, EngineUnavailableError, EngineStepError) as e:
+            what = "ticket" if kind == "result" else "session"
+            return self._reply(404, {"error": f"no {what} {sid!r}"})
+        except (DeadlineError, EngineUnavailableError, EngineStepError,
+                TicketQueueFullError) as e:
             # fault-tolerance outcomes: the session survives; 503 tells
             # the client "try again / try later", never "you sent garbage"
             return self._reply(503, {"error": str(e), "request_id": rid})

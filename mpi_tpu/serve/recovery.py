@@ -24,6 +24,16 @@ the first touch, softened by the persistent XLA cache), breaker state
 and counters (a restart is the escape hatch a breaker exists to
 approximate), and any in-flight step (the client saw an error or a dead
 connection, never a commit).
+
+Async tickets (PR 5) keep the same commit discipline: the dispatch loop
+persists a session's record only AFTER a unit-round chain's
+``block_until_ready`` returns — the generation bump and the checkpoint
+write happen per *completed* dispatch, never per enqueued ticket.  A
+``kill -9`` with tickets in flight therefore restores to the last
+completed dispatch: the replayed generation can trail the steps clients
+had enqueued, but never exceed what the device actually finished.  The
+tickets themselves are process-local and die with the process — after a
+restart, ``GET /result/<ticket>`` answers 404 and clients re-submit.
 """
 
 from __future__ import annotations

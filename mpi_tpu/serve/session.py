@@ -44,6 +44,15 @@ Fault tolerance (PR 3) wraps the whole step path:
   packed grid snapshot every ``checkpoint_every`` generations; a new
   manager over the same dir rebuilds every session by replay,
   bit-identical to an uninterrupted run.
+
+Async ticketed stepping (PR 5, ``serve/ticket.py``) is opt-in per
+request: :meth:`SessionManager.step_async` enqueues a ticket whose
+budget starts at enqueue and whose eventual outcome —
+:meth:`SessionManager.ticket_result` — carries the same
+deadline/breaker/watchdog semantics as the blocking verbs.  The
+dispatch loop decomposes depth-k tickets into unit steps so mixed-depth
+sessions share batched dispatches; the sync path (``async`` absent) is
+untouched and stays bit-identical to the pre-async code.
 """
 
 from __future__ import annotations
@@ -63,6 +72,7 @@ from mpi_tpu.models.rules import rule_from_name
 from mpi_tpu.serve import recovery
 from mpi_tpu.serve.batch import MicroBatcher
 from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.ticket import AsyncDispatcher, TicketQueueFullError
 from mpi_tpu.utils.hashinit import init_tile_np
 
 _SPEC_KEYS = {
@@ -142,6 +152,17 @@ def _parse_spec(spec: dict):
         overlap=bool(spec.get("overlap", False)),
     )
     return config, segments
+
+
+def _normalize_timeout(timeout_s: Optional[float]) -> Optional[float]:
+    """The one timeout convention, in one place: ``None`` means "no
+    explicit value" and any ``<= 0`` means "disable the budget" — both
+    normalize to ``None``.  Every budget entry point (manager default,
+    create, the blocking verbs via ``_budget``, ticket enqueue) goes
+    through here so the convention cannot drift between paths."""
+    if timeout_s is not None and timeout_s <= 0:
+        return None
+    return timeout_s
 
 
 class _Deadline:
@@ -261,6 +282,8 @@ class SessionManager:
     def __init__(self, cache: Optional[EngineCache] = None, *,
                  batching: bool = True, batch_window_ms: float = 2.0,
                  batch_max: int = 8,
+                 async_enabled: bool = True,
+                 async_queue_max: int = 1024,
                  state_dir: Optional[str] = None,
                  checkpoint_every: int = 64,
                  request_timeout_s: Optional[float] = None,
@@ -275,13 +298,19 @@ class SessionManager:
             MicroBatcher(window_ms=batch_window_ms, max_batch=batch_max)
             if batching else None
         )
+        # the async ticket path (opt-in per request; --no-async removes
+        # it entirely).  The dispatch-loop thread starts lazily on the
+        # first enqueue, so a sync-only workload never runs it.
+        self.dispatcher = (
+            AsyncDispatcher(self, window_s=max(0.0, batch_window_ms) / 1e3,
+                            queue_max=async_queue_max)
+            if async_enabled else None
+        )
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._next = 0
         # fault tolerance
-        if request_timeout_s is not None and request_timeout_s <= 0:
-            request_timeout_s = None            # 0 disables the budget
-        self.request_timeout_s = request_timeout_s
+        self.request_timeout_s = _normalize_timeout(request_timeout_s)
         if step_retries < 0:
             raise ValueError(f"step_retries must be >= 0, got {step_retries}")
         self.step_retries = int(step_retries)
@@ -313,9 +342,7 @@ class SessionManager:
         budget deliberately does NOT cover create: a cold create
         legitimately spends many seconds in XLA, and an abandoned create
         worker would still register its session) bounds the build."""
-        if timeout_s is not None and timeout_s <= 0:
-            timeout_s = None            # 0 disables, same as everywhere else
-        deadline = _Deadline(timeout_s)
+        deadline = _Deadline(_normalize_timeout(timeout_s))
         return _watchdog_call(lambda: self._create(spec), deadline, "create")
 
     def _create(self, spec: dict) -> dict:
@@ -560,7 +587,7 @@ class SessionManager:
 
     def _budget(self, timeout_s: Optional[float]) -> Optional[float]:
         if timeout_s is not None:
-            return None if timeout_s <= 0 else timeout_s
+            return _normalize_timeout(timeout_s)
         return self.request_timeout_s
 
     def _engine_failure(self, session: Session, sig, err,
@@ -644,10 +671,20 @@ class SessionManager:
     # -- verbs -------------------------------------------------------------
 
     def step(self, sid: str, steps: int = 1,
-             timeout_s: Optional[float] = None) -> dict:
+             timeout_s: Optional[float] = None, *,
+             _deadline: Optional[_Deadline] = None,
+             _use_batcher: bool = True, _unit: bool = False) -> dict:
+        """Blocking step.  The underscored keywords are the async
+        dispatcher's hooks into this same retry/breaker/watchdog loop:
+        ``_deadline`` carries a ticket's enqueue-time budget,
+        ``_use_batcher=False`` skips the sync coalescing queue (the one
+        dispatch-loop thread can never coalesce with itself), and
+        ``_unit=True`` chains depth-1 dispatches instead of compiling a
+        new depth.  The sync path never sets any of them."""
         if steps < 1:
             raise ConfigError(f"steps must be >= 1, got {steps}")
-        deadline = _Deadline(self._budget(timeout_s))
+        deadline = (_deadline if _deadline is not None
+                    else _Deadline(self._budget(timeout_s)))
         attempt = 0
         while True:
             session = self.get(sid)
@@ -661,8 +698,10 @@ class SessionManager:
                 continue                # re-get: now a host-path session
             try:
                 result = _watchdog_call(
-                    lambda: self._step_entry(session, steps), deadline,
-                    f"step({sid})")
+                    lambda: self._step_entry(session, steps,
+                                             use_batcher=_use_batcher,
+                                             unit=_unit),
+                    deadline, f"step({sid})")
             except (KeyError, ConfigError):
                 raise
             except DeadlineError as e:
@@ -691,11 +730,13 @@ class SessionManager:
                 self.cache.record_success(sig)
             return result
 
-    def _step_entry(self, session: Session, steps: int) -> dict:
+    def _step_entry(self, session: Session, steps: int,
+                    use_batcher: bool = True, unit: bool = False) -> dict:
         """One step attempt: the batched path when eligible, else solo
         under the session lock.  Runs inside the watchdog worker when a
         budget is set."""
-        if self.batcher is not None and session.engine is not None \
+        if use_batcher and self.batcher is not None \
+                and session.engine is not None \
                 and session.plan_sig is not None:
             # engine-backed steps coalesce: concurrent same-signature
             # same-depth requests share ONE stacked device dispatch; the
@@ -717,14 +758,17 @@ class SessionManager:
         try:
             if session.closed:
                 raise KeyError(session.id)
-            return self._step_locked(session, steps)
+            return self._step_locked(session, steps, unit=unit)
         finally:
             session.lock.release()
 
-    def _step_locked(self, session: Session, steps: int) -> dict:
+    def _step_locked(self, session: Session, steps: int,
+                     unit: bool = False) -> dict:
         """The solo step body; caller holds ``session.lock`` (the step
         path via :meth:`_step_entry`, the microbatch leader for
-        lone/fallback entries)."""
+        lone/fallback entries, the async dispatcher's solo fallback —
+        the latter with ``unit=True``: chain depth-1 dispatches instead
+        of compiling depth ``steps``)."""
         obs = self.obs
         if session.engine is not None:
             import jax
@@ -733,13 +777,18 @@ class SessionManager:
             # not stepping; charge it to setup_s so throughput numbers
             # stay honest (same accounting as run_tpu's phases).  The
             # engine itself records the compile event on a real miss, so
-            # the hot path adds no span around the dict hit.
+            # the hot path adds no span around the dict hit.  The unit
+            # path only ever needs depth 1 — the depth every session
+            # precompiles — so it never pays a fresh XLA program.
             t0 = time.perf_counter()
-            session.engine.ensure_compiled(session.grid, steps)
+            session.engine.ensure_compiled(session.grid, 1 if unit else steps)
             t1 = time.perf_counter()
             session.setup_s += t1 - t0
             # step donates the input buffer: replace the reference
-            grid = session.engine.step(session.grid, steps)
+            if unit:
+                grid = session.engine.step_units(session.grid, steps)
+            else:
+                grid = session.engine.step(session.grid, steps)
             td = time.perf_counter() if obs is not None else 0.0
             jax.block_until_ready(grid)
             session.grid = grid
@@ -749,8 +798,14 @@ class SessionManager:
                 # ONE event for the dispatch+sync pair (block_s splits
                 # them at read time) through the pre-bound series — the
                 # whole per-step cost of observability is ~3 µs
-                obs.event("device_dispatch", t2 - t1, t1, sid=session.id,
-                          steps=steps, block_s=round(t2 - td, 9))
+                if unit:
+                    obs.event("device_dispatch", t2 - t1, t1,
+                              sid=session.id, steps=steps, unit=True,
+                              block_s=round(t2 - td, 9))
+                else:
+                    obs.event("device_dispatch", t2 - t1, t1,
+                              sid=session.id, steps=steps,
+                              block_s=round(t2 - td, 9))
                 obs.dispatch_solo.observe(t2 - t1)
             self._mark_dispatch_ok()
         else:
@@ -766,6 +821,62 @@ class SessionManager:
         self._checkpoint(session)
         return {"id": session.id, "generation": session.generation,
                 "steps": steps}
+
+    # -- async (ticketed) stepping ----------------------------------------
+
+    def step_async(self, sid: str, steps: int = 1,
+                   timeout_s: Optional[float] = None) -> dict:
+        """Enqueue a step and return immediately with a ticket.  The
+        budget starts NOW, at enqueue — a ticket that expires while
+        queued is drained with :class:`DeadlineError` without ever
+        dispatching, and one that expires mid-flight stops advancing at
+        the last committed unit round.  ``timeout_s`` follows the same
+        convention as every blocking verb (explicit override beats the
+        server default; <= 0 disables)."""
+        if self.dispatcher is None:
+            raise ConfigError("async stepping is disabled (--no-async)")
+        if steps < 1:
+            raise ConfigError(f"steps must be >= 1, got {steps}")
+        self.get(sid)                   # unknown session -> 404 at enqueue
+        deadline = _Deadline(self._budget(timeout_s))
+        t0 = time.perf_counter()
+        ticket = self.dispatcher.submit(sid, steps, deadline)
+        if self.obs is not None:
+            self.obs.event("enqueue", time.perf_counter() - t0, t0,
+                           sid=sid, ticket=ticket.id, steps=steps)
+        return {"ticket": ticket.id, "id": sid, "status": "pending"}
+
+    def ticket_result(self, tid: str, wait: bool = False,
+                      timeout_s: Optional[float] = None) -> dict:
+        """A ticket's current outcome.  ``wait=True`` blocks until the
+        ticket resolves (bounded by the usual request budget); a
+        resolved-with-error ticket re-raises its stored exception, so
+        the HTTP layer maps it to the SAME structured 503/404 the
+        blocking path would have answered."""
+        if self.dispatcher is None:
+            raise KeyError(tid)
+        ticket = self.dispatcher.get(tid)
+        if wait:
+            # the span records how long THIS read blocked — 0 when the
+            # ticket had already resolved (emitted either way, so trace
+            # tooling sees every waited read, not just the slow ones)
+            t0 = time.perf_counter()
+            if ticket.status == "pending":
+                ticket.event.wait(self._budget(timeout_s))
+            if self.obs is not None:
+                self.obs.event("ticket_wait", time.perf_counter() - t0, t0,
+                               ticket=tid, sid=ticket.sid,
+                               resolved=ticket.status != "pending")
+        if ticket.status == "error":
+            raise ticket.error
+        out = {"ticket": ticket.id, "id": ticket.sid,
+               "status": ticket.status}
+        if ticket.status == "done":
+            out["result"] = ticket.result
+        else:
+            out["steps"] = ticket.steps
+            out["remaining"] = ticket.remaining
+        return out
 
     def snapshot(self, sid: str, timeout_s: Optional[float] = None) -> dict:
         deadline = _Deadline(self._budget(timeout_s))
@@ -848,6 +959,12 @@ class SessionManager:
                 d["restored"] = True
             if session.last_error:
                 d["last_error"] = session.last_error
+        if self.dispatcher is not None:
+            # read AFTER session.lock is released: the dispatch loop
+            # takes session locks while holding its own, never reversed
+            d["queue_depth"] = self.dispatcher.queued_for(session.id)
+            d["tickets_pending"] = self.dispatcher.pending_for(session.id)
+            d["tickets_completed"] = self.dispatcher.completed_for(session.id)
         return d
 
     def _session_list(self):
@@ -863,6 +980,8 @@ class SessionManager:
         }
         if self.batcher is not None:
             out["batch"] = self.batcher.stats()
+        if self.dispatcher is not None:
+            out["async"] = self.dispatcher.stats()
         out["breaker"] = self.cache.breaker_stats()
         out["failures"] = {
             "engine_failures": self.engine_failures,
@@ -901,6 +1020,8 @@ class SessionManager:
         return {
             "ok": ok,
             "sessions": len(sessions),
+            "tickets_pending": (self.dispatcher.pending()
+                                if self.dispatcher is not None else 0),
             "degraded_sessions": sum(1 for s in sessions if s.degraded),
             "restored_sessions": self.restored_sessions,
             "breaker": {"open": br["open"], "half_open": br["half_open"],

@@ -1,0 +1,472 @@
+"""Async ticketed stepping — the pipelined dispatch loop (PR 5).
+
+The sync step path holds its HTTP worker thread through
+``block_until_ready``, so the MicroBatcher can only coalesce requests
+that happen to collide inside a 2 ms window while their callers block.
+This module decouples the two halves: ``POST /step`` with
+``{"async": true}`` enqueues a :class:`Ticket` and returns immediately;
+a per-:class:`~mpi_tpu.serve.session.SessionManager` dispatch loop owns
+device submission, so JAX's async dispatch overlaps HTTP
+parse/serialize and checkpoint writes with device execution, and
+``GET /result/<ticket>`` (or its blocking ``?wait=1`` variant) delivers
+the eventual outcome — which may be a structured 503, because tickets
+carry the exact PR-3 deadline/watchdog/breaker semantics: a ticket's
+budget starts at enqueue, and an expired queued ticket is drained with
+:class:`~mpi_tpu.serve.session.DeadlineError` without ever dispatching.
+
+**Heterogeneous-depth (unit-step) scheduling.**  The sync batcher keys
+its queues on ``(plan_signature, depth)``, so a depth-3 and a depth-1
+request never share a dispatch.  The dispatch loop instead decomposes a
+depth-k ticket into k *unit steps* scheduled round-by-round: each round
+takes the head ticket of every session, groups the engine-backed heads
+by engine, and advances each group ``r = min(remaining)`` generations
+as a chain of depth-1 dispatches — stacked ``[B, ...]`` vmapped ones
+when B >= 2 (``Engine.step_batched`` at depth 1), a donation-safe
+``Engine.step_units`` chain when alone — with ONE sync at the end of
+the chain.  Mixed-depth sessions therefore share dispatches for as long
+as their remaining depths overlap: occupancy is bounded by concurrency,
+not depth agreement, and only the depth-1 executables (the one depth
+every session precompiles) are ever needed.
+
+In-order completion per session is structural: one dispatch loop, one
+FIFO queue per session, only the head ticket ever runs.  Generations
+stay monotonic and commits (generation bump + checkpoint) happen only
+after the chain's ``block_until_ready`` returns, so a ``kill -9``
+mid-flight restores to the last *completed* dispatch, never past it.
+
+Failure discipline mirrors the MicroBatcher: any group-chain failure
+counts ONE engine failure against the signature's breaker, then every
+ticket in the group falls back to the solo step path —
+``SessionManager.step`` with the ticket's original enqueue deadline —
+which owns retry/backoff, breaker re-check, degradation, and the
+watchdog.  Batching never changes results; it only removes dispatches.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Dict, List, Optional
+
+from mpi_tpu.obs.trace import (
+    current_request_id, reset_request_id, set_request_id,
+)
+
+
+class TicketQueueFullError(RuntimeError):
+    """The async queue is at its bound (``--async-queue-max``) —
+    backpressure, not a bug.  Maps to HTTP 503: retry later."""
+
+
+class Ticket:
+    """One enqueued async step.  ``status`` moves pending -> done|error
+    exactly once; ``event`` wakes ``?wait=1`` pollers.  ``deadline``
+    (a ``session._Deadline``) started counting at enqueue.  ``rid``
+    carries the enqueuing request's id across the thread hop to the
+    dispatch loop, same as the MicroBatcher's ``_Entry.rid``."""
+
+    __slots__ = ("id", "sid", "steps", "remaining", "deadline", "status",
+                 "result", "error", "event", "rid", "enqueued_mono",
+                 "done_mono", "unit_rounds", "max_batched")
+
+    def __init__(self, tid: str, sid: str, steps: int, deadline):
+        self.id = tid
+        self.sid = sid
+        self.steps = int(steps)
+        self.remaining = int(steps)
+        self.deadline = deadline
+        self.status = "pending"
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+        self.rid = current_request_id()
+        self.enqueued_mono = time.monotonic()
+        self.done_mono: Optional[float] = None
+        self.unit_rounds = 0            # device rounds this ticket rode in
+        self.max_batched = 0            # widest batch it shared (0 = solo)
+
+
+class AsyncDispatcher:
+    """The per-manager dispatch loop plus its ticket table.
+
+    Thread model: ``submit``/``get``/gauge callbacks run on HTTP worker
+    threads and touch shared state only under ``_cv``; the single
+    dispatch-loop thread (started lazily on the first submit, daemon) is
+    the only mutator of the per-session queues between rounds and the
+    only caller of device work.  Lock order is session.lock -> _cv
+    (commit counters update while session locks are held); nothing ever
+    acquires a session lock while holding ``_cv``.
+
+    Counters are the authoritative source for the ``/stats`` ``async``
+    section and the scrape-time ticket gauges — no shadow counting.
+    """
+
+    def __init__(self, manager, window_s: float = 0.002,
+                 queue_max: int = 1024, retain: int = 4096):
+        self.manager = manager
+        self.window_s = max(0.0, float(window_s))
+        if queue_max < 1:
+            raise ValueError(f"async queue_max must be >= 1, got {queue_max}")
+        self.queue_max = int(queue_max)
+        self.retain = max(1, int(retain))
+        self._cv = threading.Condition()
+        self._inbox: List[Ticket] = []              # enqueued, unadmitted
+        self._per_session: Dict[str, List[Ticket]] = {}     # admitted FIFO
+        self._tickets: Dict[str, Ticket] = {}
+        self._done_order: deque = deque()           # resolved-ticket eviction
+        self._completed_by_sid: Dict[str, int] = {}
+        self._next = 0
+        self._thread: Optional[threading.Thread] = None
+        self.tickets_enqueued = 0
+        self.tickets_completed = 0
+        self.tickets_expired = 0        # drained by deadline, pre- or mid-flight
+        self.group_dispatches = 0       # watchdogged unit-round chains
+        self.unit_rounds = 0            # depth-1 rounds executed (chain links)
+        self.board_rounds = 0           # boards x rounds (occupancy numerator)
+        self.max_occupancy = 0
+        self.solo_tickets = 0           # tickets routed to the solo step path
+        self.batched_fallbacks = 0      # group chains that fell back solo
+
+    # -- client side (HTTP worker threads) ---------------------------------
+
+    def submit(self, sid: str, steps: int, deadline) -> Ticket:
+        with self._cv:
+            depth = (len(self._inbox)
+                     + sum(len(q) for q in self._per_session.values()))
+            if depth >= self.queue_max:
+                raise TicketQueueFullError(
+                    f"async queue full ({depth} tickets queued, bound "
+                    f"{self.queue_max}); retry later or raise "
+                    f"--async-queue-max")
+            self._next += 1
+            ticket = Ticket(f"t{self._next}", sid, steps, deadline)
+            self._tickets[ticket.id] = ticket
+            self._inbox.append(ticket)
+            self.tickets_enqueued += 1
+            if self._thread is None:
+                # lazily started: a sync-only server never runs the loop
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="mpi_tpu-dispatch")
+                self._thread.start()
+            self._cv.notify()
+        return ticket
+
+    def get(self, tid: str) -> Ticket:
+        with self._cv:
+            ticket = self._tickets.get(tid)
+        if ticket is None:
+            raise KeyError(tid)
+        return ticket
+
+    # -- authoritative gauges (scraped + /stats + describe) ----------------
+
+    def queue_depth(self) -> int:
+        """Tickets waiting for the dispatch loop (not yet in a round)."""
+        with self._cv:
+            return (len(self._inbox)
+                    + sum(len(q) for q in self._per_session.values()))
+
+    def pending(self) -> int:
+        """Tickets enqueued but not yet resolved (includes in-dispatch)."""
+        with self._cv:
+            return sum(1 for t in self._tickets.values()
+                       if t.status == "pending")
+
+    def queued_for(self, sid: str) -> int:
+        with self._cv:
+            return (sum(1 for t in self._inbox if t.sid == sid)
+                    + len(self._per_session.get(sid, ())))
+
+    def pending_for(self, sid: str) -> int:
+        with self._cv:
+            return sum(1 for t in self._tickets.values()
+                       if t.sid == sid and t.status == "pending")
+
+    def completed_for(self, sid: str) -> int:
+        with self._cv:
+            return self._completed_by_sid.get(sid, 0)
+
+    def stats(self) -> dict:
+        with self._cv:
+            rounds = self.unit_rounds
+            return {
+                "queue_depth": (len(self._inbox)
+                                + sum(len(q)
+                                      for q in self._per_session.values())),
+                "tickets_pending": sum(1 for t in self._tickets.values()
+                                       if t.status == "pending"),
+                "tickets_enqueued": self.tickets_enqueued,
+                "tickets_completed": self.tickets_completed,
+                "tickets_expired": self.tickets_expired,
+                "group_dispatches": self.group_dispatches,
+                "unit_rounds": rounds,
+                "board_rounds": self.board_rounds,
+                "avg_occupancy": (round(self.board_rounds / rounds, 3)
+                                  if rounds else None),
+                "max_occupancy": self.max_occupancy,
+                "solo_tickets": self.solo_tickets,
+                "batched_fallbacks": self.batched_fallbacks,
+                "window_ms": self.window_s * 1e3,
+                "queue_max": self.queue_max,
+            }
+
+    def reset_stats(self) -> None:
+        """Zero the throughput counters (the async bench warms compiles,
+        then measures a clean window).  The ticket table is untouched —
+        resolved tickets must stay resolvable."""
+        with self._cv:
+            self.group_dispatches = 0
+            self.unit_rounds = 0
+            self.board_rounds = 0
+            self.max_occupancy = 0
+            self.solo_tickets = 0
+            self.batched_fallbacks = 0
+
+    # -- completion --------------------------------------------------------
+
+    def _complete(self, ticket: Ticket, result=None, error=None) -> None:
+        with self._cv:
+            if ticket.status != "pending":
+                return
+            ticket.status = "done" if error is None else "error"
+            ticket.result = result
+            ticket.error = error
+            ticket.done_mono = time.monotonic()
+            self.tickets_completed += 1
+            self._completed_by_sid[ticket.sid] = (
+                self._completed_by_sid.get(ticket.sid, 0) + 1)
+            self._done_order.append(ticket.id)
+            # bound the table: the oldest RESOLVED tickets age out; a
+            # pending ticket is never evicted (its id must resolve)
+            while len(self._done_order) > self.retain:
+                self._tickets.pop(self._done_order.popleft(), None)
+        ticket.event.set()
+
+    # -- the dispatch loop -------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._inbox and not self._per_session:
+                    self._cv.wait()
+                fresh_burst = not self._per_session
+            if fresh_burst and self.window_s:
+                # admission window: let a burst of enqueues land before
+                # the first round, so its tickets share the first batch
+                time.sleep(self.window_s)
+            with self._cv:
+                inbox, self._inbox = self._inbox, []
+                for t in inbox:
+                    self._per_session.setdefault(t.sid, []).append(t)
+            try:
+                self._run_round()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                # a scheduler bug must not strand every pending ticket;
+                # the round's heads get the error, the loop continues
+                print(f"note: async dispatch round failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                traceback.print_exc(file=sys.stderr)
+                with self._cv:
+                    heads = [q[0] for q in self._per_session.values() if q]
+                for t in heads:
+                    self._complete(t, error=RuntimeError(
+                        f"async dispatch round failed: "
+                        f"{type(e).__name__}: {e}"))
+
+    def _run_round(self) -> None:
+        from mpi_tpu.serve.session import DeadlineError
+
+        manager = self.manager
+        with self._cv:
+            for sid in list(self._per_session):
+                q = self._per_session[sid]
+                while q and q[0].status != "pending":
+                    q.pop(0)
+                if not q:
+                    del self._per_session[sid]
+            heads = sorted((q[0] for q in self._per_session.values()),
+                           key=lambda t: t.sid)
+        # deadline drain first: the budget started at enqueue, and an
+        # expired ticket must never dispatch (a queued one) nor advance
+        # further (a partially-advanced one)
+        runnable = []
+        for t in heads:
+            if t.deadline.expired():
+                with self._cv:
+                    self.tickets_expired += 1
+                done = t.steps - t.remaining
+                self._complete(t, error=DeadlineError(
+                    f"ticket {t.id} exceeded its "
+                    f"{t.deadline.seconds:.3g}s budget while queued "
+                    f"({done} of {t.steps} steps dispatched; the session "
+                    f"survives)"))
+                if manager.obs is not None:
+                    manager.obs.event("ticket_expired", sid=t.sid,
+                                      ticket=t.id, dispatched=done)
+            else:
+                runnable.append(t)
+        groups: Dict[int, list] = {}
+        solos: List[Ticket] = []
+        for t in runnable:
+            try:
+                session = manager.get(t.sid)
+            except KeyError as e:
+                self._complete(t, error=e)
+                continue
+            if (session.engine is None or session.plan_sig is None
+                    or not manager.cache.breaker_allows(session.plan_sig)):
+                # host backends, degraded boards, and quarantined plans
+                # take the solo path — manager.step owns breaker
+                # handling (degrade or 503) exactly as the sync path does
+                solos.append(t)
+            else:
+                groups.setdefault(id(session.engine),
+                                  []).append((t, session))
+        for group in groups.values():
+            solos.extend(self._run_group(group))
+        for t in solos:
+            self._run_solo(t)
+
+    def _run_group(self, group) -> List[Ticket]:
+        """One unit-round chain for the head tickets sharing an engine:
+        advance every board ``r = min(remaining)`` generations through
+        chained depth-1 dispatches (stacked when B >= 2), ONE sync at
+        the end, then commit.  Returns the tickets that must fall back
+        to the solo path (run by the caller AFTER the session locks here
+        are released — the solo path takes them itself)."""
+        import jax
+
+        from mpi_tpu.serve.session import (
+            _Deadline, _watchdog_call, DeadlineError,
+        )
+
+        manager = self.manager
+        obs = manager.obs
+        group.sort(key=lambda ts: ts[1].id)
+        engine = group[0][1].engine
+        # the watchdog budget for the shared chain is the tightest
+        # participant's remaining budget — a timeout fails the chain and
+        # every ticket re-tries solo under its OWN deadline
+        finite = [t.deadline.remaining() for t, _ in group
+                  if t.deadline.seconds is not None]
+        deadline = _Deadline(min(finite) if finite else None)
+        for _, s in group:
+            s.lock.acquire()
+        try:
+            for t, s in group:
+                if s.closed or s.engine is None:
+                    self._complete(t, error=KeyError(s.id))
+            live = [(t, s) for t, s in group
+                    if not (s.closed or s.engine is None)]
+            if not live:
+                return []
+            B = len(live)
+            r = min(t.remaining for t, _ in live)
+            sig = live[0][1].plan_sig
+            t1 = time.perf_counter()
+
+            def work():
+                if B == 1:
+                    s = live[0][1]
+                    s.engine.ensure_compiled(s.grid, 1)
+                    g = engine.step_units(s.grid, r)
+                    jax.block_until_ready(g)
+                    return [g]
+                stepper, _hit = manager.cache.get_or_build_batched(
+                    sig, B, lambda: engine.batched_stepper(B))
+                stacked = engine.stack_grids([s.grid for _, s in live])
+                engine.ensure_compiled_batched(stacked, 1)
+                for _ in range(r):
+                    stacked = stepper(stacked, 1)
+                jax.block_until_ready(stacked)
+                return engine.unstack_grids(stacked)
+
+            try:
+                boards = _watchdog_call(work, deadline,
+                                        f"unit_round[B={B},r={r}]")
+            except Exception as e:  # noqa: BLE001 — solo fallback decides
+                manager._engine_failure(live[0][1], sig, e,
+                                        timeout=isinstance(e, DeadlineError))
+                with self._cv:
+                    self.batched_fallbacks += 1
+                return [t for t, _ in live]
+            t2 = time.perf_counter()
+            if obs is not None:
+                obs.event("unit_round", t2 - t1, t1, B=B, rounds=r,
+                          sids=[s.id for _, s in live],
+                          request_ids=[t.rid for t, _ in live])
+                obs.occupancy_series.observe(B)
+                (obs.dispatch_batched if B > 1
+                 else obs.dispatch_solo).observe(t2 - t1)
+            per_board = (t2 - t1) / B
+            for (t, s), grid in zip(live, boards):
+                s.grid = grid
+                s.generation += r
+                s.steady_s += per_board
+                if B > 1:
+                    s.batched_steps += 1
+                # commit under the submitter's request id so the
+                # checkpoint write's span carries it (loop thread)
+                token = set_request_id(t.rid)
+                try:
+                    manager._checkpoint(s)
+                finally:
+                    reset_request_id(token)
+                t.remaining -= r
+                t.unit_rounds += r
+                t.max_batched = max(t.max_batched, B if B > 1 else 0)
+                if t.remaining == 0:
+                    self._complete(t, result={
+                        "id": s.id, "generation": s.generation,
+                        "steps": t.steps, "async": True,
+                        "unit_rounds": t.unit_rounds,
+                        "max_batched": t.max_batched})
+            manager._mark_dispatch_ok()
+            manager.cache.record_success(sig)
+            with self._cv:
+                self.group_dispatches += 1
+                self.unit_rounds += r
+                self.board_rounds += B * r
+                self.max_occupancy = max(self.max_occupancy, B)
+            return []
+        finally:
+            for _, s in group:
+                s.lock.release()
+
+    def _run_solo(self, ticket: Ticket) -> None:
+        """The solo path: ``manager.step`` with the ticket's original
+        enqueue deadline, bypassing the sync MicroBatcher (one loop
+        thread can never coalesce with itself) but keeping every PR-3
+        semantic — breaker check, degrade, retry/backoff, watchdog —
+        and chaining the remaining depth as donation-safe unit steps."""
+        manager = self.manager
+        with self._cv:
+            self.solo_tickets += 1
+        token = set_request_id(ticket.rid)
+        try:
+            res = dict(manager.step(ticket.sid, ticket.remaining,
+                                    _deadline=ticket.deadline,
+                                    _use_batcher=False, _unit=True))
+            res["steps"] = ticket.steps
+            res["async"] = True
+            res["unit_rounds"] = ticket.unit_rounds + ticket.remaining
+            res["max_batched"] = ticket.max_batched
+            ticket.unit_rounds += ticket.remaining
+            ticket.remaining = 0
+            self._complete(ticket, result=res)
+        except Exception as e:  # noqa: BLE001 — delivered via the ticket
+            if isinstance(e, _deadline_error_type()):
+                with self._cv:
+                    self.tickets_expired += 1
+            self._complete(ticket, error=e)
+        finally:
+            reset_request_id(token)
+
+
+def _deadline_error_type():
+    from mpi_tpu.serve.session import DeadlineError
+
+    return DeadlineError

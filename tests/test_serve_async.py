@@ -1,0 +1,418 @@
+"""Tier-1 tests for async ticketed stepping (``serve/ticket.py``) — the
+PR 5 tentpole: tickets carry the PR-3 deadline/breaker/watchdog
+semantics, the dispatch loop commits only completed unit rounds, and
+heterogeneous-depth tickets coalesce into shared stacked dispatches
+with results bit-identical to the ``serial_np`` oracle.
+
+All on CPU devices (conftest pins JAX_PLATFORMS=cpu, 8 virtual
+devices), on the warm 64x64 shapes the rest of the serve suite
+compiles.
+"""
+
+import json
+import os
+import signal  # noqa: F401 — parity with the recovery suite's imports
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.config import ConfigError
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.serve import (
+    DeadlineError,
+    EngineCache,
+    TicketQueueFullError,
+)
+from mpi_tpu.serve.httpd import make_server
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+
+TPU_SPEC = {"rows": 64, "cols": 64, "backend": "tpu"}
+
+
+def _oracle(rows, cols, seed, steps, boundary="periodic", rule=LIFE):
+    return evolve_np(init_tile_np(rows, cols, seed), steps, rule, boundary)
+
+
+def _grid_of(snap):
+    return np.array([[int(c) for c in row] for row in snap["grid"]],
+                    dtype=np.uint8)
+
+
+def _resolve(mgr, ticket, timeout_s=120):
+    return mgr.ticket_result(ticket["ticket"], wait=True,
+                             timeout_s=timeout_s)
+
+
+# --------------------------------------------------------- basic tickets
+
+
+def test_async_roundtrip_parity_and_result_shape():
+    mgr = SessionManager(EngineCache(max_size=4))
+    sid = mgr.create(dict(TPU_SPEC, seed=51))["id"]
+    t = mgr.step_async(sid, 3)
+    assert t["status"] == "pending" and t["id"] == sid
+    out = _resolve(mgr, t)
+    assert out["status"] == "done"
+    assert out["result"]["generation"] == 3
+    assert out["result"]["steps"] == 3 and out["result"]["async"] is True
+    snap = mgr.snapshot(sid)
+    assert snap["generation"] == 3
+    assert np.array_equal(_grid_of(snap), _oracle(64, 64, 51, 3))
+    # a resolved ticket stays resolvable (idempotent reads)
+    again = mgr.ticket_result(t["ticket"])
+    assert again["result"] == out["result"]
+
+
+def test_unknown_ticket_and_bad_steps():
+    mgr = SessionManager(EngineCache(max_size=4))
+    with pytest.raises(KeyError):
+        mgr.ticket_result("t999")
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    with pytest.raises(ConfigError):
+        mgr.step_async(sid, 0)
+    with pytest.raises(KeyError):
+        mgr.step_async("nope", 1)       # unknown session fails AT enqueue
+
+
+def test_async_disabled_manager_rejects():
+    mgr = SessionManager(EngineCache(max_size=4), async_enabled=False)
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    with pytest.raises(ConfigError):
+        mgr.step_async(sid, 1)
+    with pytest.raises(KeyError):
+        mgr.ticket_result("t1")
+    # the sync verbs are untouched
+    assert mgr.step(sid, 2)["generation"] == 2
+
+
+def test_host_backend_tickets_resolve_in_order():
+    """Host sessions ride the solo path; per-session FIFO keeps the
+    generations monotonic across several queued tickets."""
+    mgr = SessionManager(EngineCache(max_size=4), batch_window_ms=20.0)
+    sid = mgr.create({"rows": 32, "cols": 32, "backend": "serial",
+                      "seed": 7})["id"]
+    tickets = [mgr.step_async(sid, k) for k in (2, 3, 1)]
+    outs = [_resolve(mgr, t) for t in tickets]
+    gens = [o["result"]["generation"] for o in outs]
+    assert gens == [2, 5, 6]            # enqueue order, cumulative
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                          _oracle(32, 32, 7, 6))
+
+
+# ------------------------------------------- heterogeneous-depth batching
+
+
+def test_mixed_depths_coalesce_with_oracle_parity():
+    """The tentpole scheduling property: depths {1, 2, 5} on one plan
+    signature share stacked unit-step dispatches (the sync batcher could
+    never coalesce them), and every board stays bit-identical to the
+    oracle."""
+    mgr = SessionManager(EngineCache(max_size=4), batch_window_ms=50.0)
+    depths = [1, 2, 5]
+    sids = [mgr.create(dict(TPU_SPEC, seed=60 + i))["id"]
+            for i in range(len(depths))]
+    # all three enqueues land inside the dispatch loop's admission
+    # window (submits are microseconds; the window is 50 ms)
+    tickets = [mgr.step_async(s, d) for s, d in zip(sids, depths)]
+    outs = [_resolve(mgr, t) for t in tickets]
+    for i, (sid, d, out) in enumerate(zip(sids, depths, outs)):
+        assert out["result"]["generation"] == d
+        snap = mgr.snapshot(sid)
+        assert snap["generation"] == d
+        assert np.array_equal(_grid_of(snap), _oracle(64, 64, 60 + i, d)), \
+            f"mixed-depth parity broke for sid={sid} depth={d}"
+    # the depth-1 ticket shared a [B, ...] dispatch with the others
+    assert max(o["result"]["max_batched"] for o in outs) >= 2
+    engine = mgr.get(sids[0]).engine
+    assert engine.batched_step_calls >= 1
+    st = mgr.stats()["async"]
+    assert st["tickets_completed"] == 3 and st["max_occupancy"] >= 2
+    # round-by-round unit scheduling: more board-rounds than rounds
+    assert st["board_rounds"] > st["unit_rounds"]
+
+
+def test_unit_chain_needs_no_new_compiles():
+    """A depth-5 ticket advances through chained depth-1 dispatches —
+    the one executable every session precompiles — so async stepping
+    never pays a fresh XLA program."""
+    mgr = SessionManager(EngineCache(max_size=4))
+    sid = mgr.create(dict(TPU_SPEC, seed=71))["id"]
+    engine = mgr.get(sid).engine
+    before = engine.compile_count
+    out = _resolve(mgr, mgr.step_async(sid, 5))
+    assert out["result"]["generation"] == 5
+    assert engine.compile_count == before
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                          _oracle(64, 64, 71, 5))
+
+
+def test_sync_and_async_interleave_consistently():
+    """Sync steps and tickets against the same board serialize through
+    the session lock; the final board equals the oracle at the summed
+    generation."""
+    mgr = SessionManager(EngineCache(max_size=4))
+    sid = mgr.create(dict(TPU_SPEC, seed=77))["id"]
+    mgr.step(sid, 2)
+    t = mgr.step_async(sid, 3)
+    _resolve(mgr, t)
+    mgr.step(sid, 1)
+    snap = mgr.snapshot(sid)
+    assert snap["generation"] == 6
+    assert np.array_equal(_grid_of(snap), _oracle(64, 64, 77, 6))
+
+
+# ------------------------------------------------- tickets x fault paths
+
+
+def test_queued_ticket_expires_before_dispatch():
+    """A ticket whose budget (started at enqueue) runs out while queued
+    behind a slow board is drained with DeadlineError WITHOUT ever
+    dispatching; the session survives."""
+    mgr = SessionManager(EngineCache(max_size=4),
+                         faults="step:1:delay:0.5")
+    sid = mgr.create(dict(TPU_SPEC, seed=81))["id"]
+    engine = mgr.get(sid).engine
+    slow = mgr.step_async(sid, 1)               # dispatch #1: 0.5 s delay
+    doomed = mgr.step_async(sid, 1, timeout_s=0.1)  # queued behind it
+    assert _resolve(mgr, slow)["result"]["generation"] == 1
+    with pytest.raises(DeadlineError, match="never|while queued|budget"):
+        mgr.ticket_result(doomed["ticket"], wait=True, timeout_s=30)
+    # the doomed ticket never touched the device
+    assert engine.step_calls == 1
+    assert mgr.dispatcher.tickets_expired == 1
+    # the session is intact and steps on
+    assert mgr.step(sid, 1)["generation"] == 2
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                          _oracle(64, 64, 81, 2))
+
+
+def test_ticket_pending_while_breaker_opens_degrades_with_parity():
+    """Injected faults open the breaker while a ticket is pending: the
+    ticket's outcome is the degraded path's (bit-identical, served by
+    serial_np) and the session survives."""
+    cache = EngineCache(max_size=4, breaker_threshold=3,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=2, retry_backoff_s=0.001,
+                         faults="step:1-5:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=91))["id"]
+    out = _resolve(mgr, mgr.step_async(sid, 4))
+    assert out["status"] == "done"
+    assert out["result"]["generation"] == 4
+    s = mgr.get(sid)
+    assert s.degraded and s.engine is None
+    assert mgr.stats()["breaker"]["open"]
+    assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                          _oracle(64, 64, 91, 4))
+
+
+def test_ticket_503_when_breaker_opens_without_degrade():
+    cache = EngineCache(max_size=4, breaker_threshold=2,
+                        breaker_cooldown_s=60.0)
+    mgr = SessionManager(cache, step_retries=3, retry_backoff_s=0.001,
+                         degrade=False, faults="step:*:raise")
+    sid = mgr.create(dict(TPU_SPEC, seed=95))["id"]
+    t = mgr.step_async(sid, 1)
+    from mpi_tpu.serve import EngineUnavailableError
+
+    with pytest.raises(EngineUnavailableError):
+        mgr.ticket_result(t["ticket"], wait=True, timeout_s=30)
+    # the board itself was never advanced nor lost
+    assert mgr.get(sid).generation == 0
+
+
+def test_async_queue_bound_backpressure():
+    mgr = SessionManager(EngineCache(max_size=4), batch_window_ms=200.0,
+                         async_queue_max=2)
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    mgr.step_async(sid, 1)
+    mgr.step_async(sid, 1)
+    with pytest.raises(TicketQueueFullError):
+        mgr.step_async(sid, 1)
+
+
+# ----------------------------------------------------------- HTTP layer
+
+
+@pytest.fixture()
+def server():
+    mgr = SessionManager(EngineCache(max_size=4), batch_window_ms=20.0)
+    srv = make_server(port=0, manager=mgr)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+    thread.join(timeout=5)
+
+
+def _req(srv, method, path, body=None):
+    host, port = srv.server_address[:2]
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_async_roundtrip(server):
+    _, created = _req(server, "POST", "/sessions",
+                      dict(TPU_SPEC, seed=101))
+    sid = created["id"]
+    code, t = _req(server, "POST", f"/sessions/{sid}/step?async=1",
+                   {"steps": 4})
+    assert code == 200 and t["status"] == "pending" and "ticket" in t
+    code, out = _req(server, "GET", f"/result/{t['ticket']}?wait=1")
+    assert code == 200 and out["status"] == "done"
+    assert out["result"]["generation"] == 4
+    # the body flag spells the same opt-in
+    code, t2 = _req(server, "POST", f"/sessions/{sid}/step",
+                    {"steps": 1, "async": True})
+    assert code == 200 and t2["status"] == "pending"
+    code, out2 = _req(server, "GET", f"/result/{t2['ticket']}?wait=1")
+    assert code == 200 and out2["result"]["generation"] == 5
+    code, snap = _req(server, "GET", f"/sessions/{sid}/snapshot")
+    assert np.array_equal(_grid_of(snap), _oracle(64, 64, 101, 5))
+    # stats and describe surface the ticket counters
+    _, stats = _req(server, "GET", "/stats")
+    assert stats["async"]["tickets_completed"] == 2
+    sess = [s for s in stats["sessions"] if s["id"] == sid][0]
+    assert sess["tickets_completed"] == 2
+    assert {"queue_depth", "tickets_pending"} <= set(sess)
+    code, _ = _req(server, "GET", "/result/t999")
+    assert code == 404
+
+
+def test_http_expired_ticket_is_same_structured_503(server):
+    """The acceptance criterion's shape check: a ticket that hits its
+    deadline answers the exact structured 503 the blocking path uses —
+    {"error": ..., "request_id": ...}."""
+    _, created = _req(server, "POST", "/sessions",
+                      {"rows": 32, "cols": 32, "backend": "serial",
+                       "seed": 5})
+    sid = created["id"]
+    # a long host step occupies the session; the second ticket expires
+    # in the queue behind it
+    code, slow = _req(server, "POST",
+                      f"/sessions/{sid}/step?async=1", {"steps": 400})
+    assert code == 200
+    code, doomed = _req(server, "POST",
+                        f"/sessions/{sid}/step?async=1&timeout_s=0.001",
+                        {"steps": 1})
+    assert code == 200
+    code, body = _req(server, "GET", f"/result/{doomed['ticket']}?wait=1")
+    assert code == 503
+    assert "error" in body and "request_id" in body
+    assert "budget" in body["error"]
+    code, out = _req(server, "GET", f"/result/{slow['ticket']}?wait=1")
+    assert code == 200 and out["result"]["generation"] == 400
+
+
+def test_http_async_disabled_is_400():
+    mgr = SessionManager(EngineCache(max_size=4), async_enabled=False)
+    srv = make_server(port=0, manager=mgr)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        _, created = _req(srv, "POST", "/sessions",
+                          {"rows": 16, "cols": 16, "backend": "serial"})
+        code, body = _req(srv, "POST",
+                          f"/sessions/{created['id']}/step?async=1",
+                          {"steps": 1})
+        assert code == 400 and "async" in body["error"]
+        code, _ = _req(srv, "GET", "/result/t1")
+        assert code == 404
+        # sync stepping is untouched
+        code, r = _req(srv, "POST", f"/sessions/{created['id']}/step",
+                       {"steps": 2})
+        assert code == 200 and r["generation"] == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        thread.join(timeout=5)
+
+
+# --------------------------------------------- SIGKILL with live tickets
+
+
+def _wait_for_serving(proc):
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError("server exited before announcing its port")
+        if "serving on http://" in line:
+            addr = line.split("http://", 1)[1].split(" ", 1)[0]
+            host, port = addr.rsplit(":", 1)
+            return host, int(port)
+    raise AssertionError("server never announced its port")
+
+
+def _http(host, port, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_sigkill_with_tickets_in_flight_restores_completed_prefix(tmp_path):
+    """SIGKILL the server with async tickets still in flight, restart on
+    the same --state-dir: the restored generation reflects only
+    *completed* dispatches (never a partial commit), the board is
+    bit-identical to the oracle at that generation, and the tickets
+    themselves are gone (process-local by design)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = [sys.executable, "-m", "mpi_tpu.cli", "serve", "--port", "0",
+            "--state-dir", str(tmp_path), "--checkpoint-every", "1"]
+    n_tickets, depth = 40, 5
+    p1 = subprocess.Popen(args, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, text=True, env=env)
+    try:
+        host, port = _wait_for_serving(p1)
+        sid = _http(host, port, "POST", "/sessions",
+                    {"rows": 64, "cols": 64, "backend": "serial",
+                     "seed": 23})["id"]
+        for _ in range(n_tickets):
+            t = _http(host, port, "POST",
+                      f"/sessions/{sid}/step?async=1", {"steps": depth})
+            assert t["status"] == "pending"
+        time.sleep(0.05)                # let a prefix complete
+    finally:
+        p1.kill()                       # SIGKILL mid-flight, no shutdown
+        p1.wait(timeout=30)
+        p1.stdout.close()
+
+    p2 = subprocess.Popen(args, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, text=True, env=env)
+    try:
+        host, port = _wait_for_serving(p2)
+        snap = _http(host, port, "GET", f"/sessions/{sid}/snapshot")
+        g = snap["generation"]
+        # only whole completed dispatches persist: a multiple of the
+        # ticket depth, never past what was enqueued
+        assert 0 <= g <= n_tickets * depth
+        assert g % depth == 0
+        assert np.array_equal(_grid_of(snap), _oracle(64, 64, 23, g)), \
+            "restored board is not the oracle at its recorded generation"
+        # in-flight tickets died with the process
+        with pytest.raises(urllib.error.HTTPError):
+            _http(host, port, "GET", "/result/t1")
+        # the restored board keeps stepping on the oracle
+        _http(host, port, "POST", f"/sessions/{sid}/step", {"steps": 3})
+        snap2 = _http(host, port, "GET", f"/sessions/{sid}/snapshot")
+        assert np.array_equal(_grid_of(snap2), _oracle(64, 64, 23, g + 3))
+    finally:
+        p2.kill()
+        p2.wait(timeout=30)
+        p2.stdout.close()

@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""End-to-end observability smoke: serve on XLA:CPU, drive ~20 mixed
-requests, then scrape ``GET /metrics`` and the ``--trace-log`` JSONL and
-fail LOUDLY (exit 1) on any schema drift — missing metric families,
-non-monotone histogram buckets, malformed trace records, or a request
-whose lifecycle cannot be reconstructed by its shared request id.
+"""End-to-end observability smoke: serve on XLA:CPU, drive ~30 mixed
+requests — including async tickets and a mixed-depth burst — then scrape
+``GET /metrics`` and the ``--trace-log`` JSONL and fail LOUDLY (exit 1)
+on any schema drift — missing metric families (now including the ticket
+gauges), non-monotone histogram buckets, malformed trace records, a
+request whose lifecycle cannot be reconstructed by its shared request
+id, a missing async span kind (``enqueue``/``ticket_wait``/
+``unit_round``), or a ticket that does not resolve exactly once.
 
 This is the contract check for PR 4's tentpole: dashboards and trace
 tooling parse these two text formats, so their shape is API.  Run
@@ -42,7 +45,13 @@ REQUIRED_METRICS = [
     "mpi_tpu_engine_counters_total",
     "mpi_tpu_batch_queue_depth",
     "mpi_tpu_trace_spans_total",
+    "mpi_tpu_ticket_queue_depth",
+    "mpi_tpu_tickets_pending",
+    "mpi_tpu_tickets_completed_total",
+    "mpi_tpu_unit_rounds_total",
 ]
+# span kinds the async path must leave in the trace (PR 5)
+ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # every trace record must carry exactly these core keys
 TRACE_KEYS = {"seq", "name", "t_unix", "t_mono", "dur_s", "thread"}
 
@@ -109,9 +118,12 @@ def check_histograms(types, samples):
                 f"({counts.get((base, lk))})")
 
 
-def check_trace(path):
+def check_trace(path, require_async=False):
     """Every JSONL record well-formed; at least one http_request span
-    shares its rid with a dispatch span (lifecycle reconstructable)."""
+    shares its rid with a dispatch span (lifecycle reconstructable).
+    ``require_async`` additionally demands the PR-5 span kinds — set by
+    the smoke's own traffic (which drives tickets); importers checking
+    async-free traffic leave it off."""
     recs = []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
@@ -136,6 +148,12 @@ def check_trace(path):
         raise ValueError(
             "no request id links an http_request span to a dispatch span; "
             f"rids seen: { {k: sorted(v) for k, v in by_rid.items()} }")
+    if require_async:
+        seen_kinds = {r["name"] for r in recs}
+        missing_kinds = ASYNC_SPAN_KINDS - seen_kinds
+        if missing_kinds:
+            raise ValueError(f"trace missing async span kinds: "
+                             f"{sorted(missing_kinds)}")
     return len(recs), len(linked)
 
 
@@ -205,7 +223,42 @@ def main():
         call("GET", "/stats")
         call("DELETE", f"/sessions/{sid_c}")
 
-        code, text = call("GET", "/metrics")   # request 19; the counter
+        # -- async tickets: a mixed-depth burst (PR 5) -----------------
+        # depths {1, 2, 5} on one 64x64 signature: the sync batcher
+        # could never coalesce these; the unit-step dispatch loop can
+        _, body = call("POST", "/sessions",
+                       {"rows": 64, "cols": 64, "backend": "tpu"})
+        sid_d = json.loads(body)["id"]
+        burst = [(sid_a, 1), (sid_b, 2), (sid_d, 5)]
+        tickets = []
+        for sid, depth in burst:
+            code, body = call("POST", f"/sessions/{sid}/step?async=1",
+                              {"steps": depth})
+            assert code == 200, f"async step -> {code}"
+            t = json.loads(body)
+            assert t["status"] == "pending" and t["id"] == sid, t
+            tickets.append((t["ticket"], sid, depth))
+        if len({tid for tid, _, _ in tickets}) != len(tickets):
+            raise ValueError(f"ticket ids not unique: {tickets}")
+        results = {}
+        for tid, sid, depth in tickets:
+            code, body = call("GET", f"/result/{tid}?wait=1")
+            assert code == 200, f"/result/{tid} -> {code}"
+            out = json.loads(body)
+            if out["status"] != "done":
+                raise ValueError(f"ticket {tid} did not resolve: {out}")
+            results[tid] = out["result"]
+        # exactly once: a re-read answers the SAME terminal outcome —
+        # no ticket resolves twice, none flips after resolving
+        for tid, sid, depth in tickets:
+            _, body = call("GET", f"/result/{tid}")
+            again = json.loads(body)
+            if again["status"] != "done" or again["result"] != results[tid]:
+                raise ValueError(
+                    f"ticket {tid} did not resolve exactly once: "
+                    f"first {results[tid]}, re-read {again}")
+
+        code, text = call("GET", "/metrics")   # final request; the counter
         assert code == 200, f"/metrics -> {code}"  # increments post-render
         types, samples = parse_prometheus(text)
         # family presence from the TYPE lines — the registry emits them
@@ -216,18 +269,38 @@ def main():
         check_histograms(types, samples)
         http_total = sum(v for n, _, v in samples
                          if n == "mpi_tpu_http_requests_total")
-        # 18 requests precede the scrape, but the counter increments
+        # 28 requests precede the scrape, but the counter increments
         # after the response bytes go out, so the scrape may race the
         # increment of the request answered just before it
-        if http_total < 17:
-            raise ValueError(f"expected >= 17 http requests counted, "
+        if http_total < 27:
+            raise ValueError(f"expected >= 27 http requests counted, "
                              f"got {http_total}")
+        # the ticket gauges are scrape-time reads over the dispatcher's
+        # authoritative queue state: everything resolved, nothing queued
+        vals = {n: v for n, labels, v in samples if not labels}
+        if vals.get("mpi_tpu_tickets_completed_total") != len(tickets):
+            raise ValueError(
+                f"tickets_completed_total = "
+                f"{vals.get('mpi_tpu_tickets_completed_total')}, expected "
+                f"{len(tickets)}")
+        for gauge in ("mpi_tpu_tickets_pending", "mpi_tpu_ticket_queue_depth"):
+            if vals.get(gauge) != 0:
+                raise ValueError(f"{gauge} = {vals.get(gauge)} after all "
+                                 f"tickets resolved, expected 0")
+        # every unit round of the burst went through the dispatch loop:
+        # at least the deepest ticket's depth, at most the board-rounds sum
+        unit_rounds = vals.get("mpi_tpu_unit_rounds_total", 0)
+        max_depth = max(d for _, d in burst)
+        total_depth = sum(d for _, d in burst)
+        if not (max_depth <= unit_rounds <= total_depth):
+            raise ValueError(f"unit_rounds_total = {unit_rounds}, expected "
+                             f"in [{max_depth}, {total_depth}]")
     finally:
         server.shutdown()
         server.server_close()
         obs.close()
 
-    n_recs, n_linked = check_trace(trace_log)
+    n_recs, n_linked = check_trace(trace_log, require_async=True)
     print(f"obs smoke OK: {len(samples)} metric samples, "
           f"{n_recs} trace records, {n_linked} request lifecycles linked "
           f"({trace_log})")

@@ -1212,6 +1212,107 @@ def serve_bench_async() -> None:
     print(json.dumps(out))
 
 
+def sparse_bench() -> None:
+    """`python bench.py --sparse`: the activity-gating A/B (ISSUE 6).
+
+    Sweeps the quiescent-tile fraction on the dispatch-amortized config
+    (4096^2 packed Life, T=128 tiles, one 200-step dispatch) — the
+    regime the gate is for: big board, deep dispatch, activity confined
+    to a few tiles.  Boards:
+
+    * **q=1.0 / 0.99 / 0.9** — clustered blinkers occupying (1-q) of
+      the tiles; everything else is dead and the sparse phases skip it.
+    * **q=0.0** — a 35% random soup: every tile busy, the hysteresis
+      gate must fall through to the dense chunk ladder and cost (gate)
+      <= 5% over the plain dense engine.
+
+    Dense and sparse run in the same process, best-of-``reps`` with a
+    32-step settle before each timed window (the first sparse dispatch
+    starts all-dirty by construction).  Throughput is **effective**
+    cells/s — whole-board area over wall time, NOT active-area — so
+    dense and sparse numbers are directly comparable and the speedup is
+    real end-to-end gain.  Gates: >= 5x at q=0.99, <= 5% overhead at
+    q=0.0.  One JSON line.
+    """
+    out = {"bench": "sparse", "ok": False}
+    try:
+        import jax
+        import numpy as np
+
+        from mpi_tpu.backends.tpu import build_engine
+        from mpi_tpu.config import GolConfig
+
+        N, T, steps, reps, settle = 4096, 128, 200, 3, 32
+        base = dict(rows=N, cols=N, steps=0, backend="tpu",
+                    mesh_shape=(1, 1))
+
+        def bench_one(cfg, board):
+            eng = build_engine(cfg)
+            g = eng.step(eng.init_grid(initial=board), steps)  # warm
+            jax.block_until_ready(eng.raw_grid(g))
+            best = float("inf")
+            for _ in range(reps):
+                gi = eng.step(eng.init_grid(initial=board), settle)
+                jax.block_until_ready(eng.raw_grid(gi))
+                t0 = time.perf_counter()
+                gi = eng.step(gi, steps)
+                jax.block_until_ready(eng.raw_grid(gi))
+                best = min(best, time.perf_counter() - t0)
+            return eng, gi, best
+
+        def quiescent_board(frac_active):
+            # one blinker per active tile, tiles packed into a square
+            # block (clustered, so the active set is as gather-friendly
+            # as a real localized pattern)
+            b = np.zeros((N, N), dtype=np.uint8)
+            ntiles = (N // T) ** 2
+            k = int(round(frac_active * ntiles))
+            side = int(np.ceil(np.sqrt(max(k, 1))))
+            placed = 0
+            for i in range(side):
+                for j in range(side):
+                    if placed >= k:
+                        break
+                    r, c = i * T + T // 2, j * T + T // 2
+                    b[r, c - 1:c + 2] = 1
+                    placed += 1
+            return b
+
+        rng = np.random.default_rng(1)
+        cases = [("1.00", quiescent_board(0.0)),
+                 ("0.99", quiescent_board(0.01)),
+                 ("0.90", quiescent_board(0.1)),
+                 ("0.00", (rng.random((N, N)) < 0.35).astype(np.uint8))]
+        cells = N * N * steps
+        sweep = {}
+        for q, board in cases:
+            _, _, td = bench_one(GolConfig(**base), board)
+            es, gs, ts = bench_one(GolConfig(**base, sparse_tile=T), board)
+            st = es.sparse_stats(gs)
+            sweep[q] = {
+                "dense_ms": round(td * 1e3, 1),
+                "sparse_ms": round(ts * 1e3, 1),
+                "speedup": round(td / ts, 3),
+                "dense_cells_per_s": round(cells / td),
+                "sparse_eff_cells_per_s": round(cells / ts),
+                "active_tiles": st["active_tiles"],
+                "ntiles": st["ntiles"],
+                "mode": st["mode"],
+            }
+        overhead = sweep["0.00"]["sparse_ms"] / sweep["0.00"]["dense_ms"] - 1
+        out.update(
+            ok=True, rows=N, cols=N, tile=T, steps=steps, reps=reps,
+            sweep=sweep,
+            soup_overhead_pct=round(overhead * 100, 2),
+            gate_speedup_q99=sweep["0.99"]["speedup"],
+            gate_speedup_q99_ok=sweep["0.99"]["speedup"] >= 5.0,
+            gate_soup_overhead_ok=overhead <= 0.05,
+        )
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         probe()
@@ -1225,6 +1326,8 @@ if __name__ == "__main__":
         serve_bench_recovery()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-obs":
         serve_bench_obs()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--sparse":
+        sparse_bench()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":

@@ -27,6 +27,12 @@ from mpi_tpu.utils.segmenting import segment_depths
 from mpi_tpu.utils.timing import PhaseTimer
 
 SnapshotCb = Callable[[int, List[Tuple[int, np.ndarray, int, int]]], None]
+
+
+def _batch_width(grids) -> int:
+    """Leading (board) axis of a stacked batch — pytree-safe, because a
+    sparse engine's batch is a stacked SparseState, not a bare array."""
+    return int(jax.tree_util.tree_leaves(grids)[0].shape[0])
 # snapshot_cb(iteration, [(pid, tile, first_row, first_col), ...]) —
 # pids are globally unique (row-major over the global tile grid), so each
 # host of a multi-host run can write its own shards without collisions.
@@ -397,7 +403,7 @@ class Engine:
 
     def __init__(self, config: GolConfig, mesh, evolve, *, bitpacked: bool,
                  cols_eff: int, pad_bits: int, used_pallas: bool,
-                 fallback_factory, notes=()):
+                 fallback_factory, notes=(), sparse_plan=None):
         from mpi_tpu.parallel.mesh import AXES
 
         self.config = config
@@ -407,6 +413,11 @@ class Engine:
         self.cols_eff = cols_eff
         self.pad_bits = pad_bits
         self.notes = tuple(notes)
+        # activity-gated sparse stepping (ops/activity.py): when set, the
+        # "grid" every step method passes around is a SparseState pytree
+        # (grid + dirty-tile map); fetch/population/snapshot paths unwrap
+        # via raw_grid, everything else is opaque
+        self.sparse_plan = sparse_plan
         self._evolve = evolve
         self._used_pallas = used_pallas
         self._fallback_factory = fallback_factory
@@ -439,23 +450,50 @@ class Engine:
     def init_grid(self, initial=None, seed=None):
         """A fresh device-resident grid on this engine's mesh/sharding.
         ``seed`` overrides config.seed: serve sessions share one engine
-        across seeds (the seed is deliberately not in the plan key)."""
+        across seeds (the seed is deliberately not in the plan key).
+        Sparse engines return a SparseState (every tile marked dirty —
+        the first steps probe and settle the gate on their own)."""
         seed = self.config.seed if seed is None else seed
         if self.bitpacked:
             from mpi_tpu.parallel.step import sharded_bit_init
 
             if initial is not None:
-                return _put_initial(self.mesh, initial, self.config.rows,
+                grid = _put_initial(self.mesh, initial, self.config.rows,
                                     self.cols_eff, True,
                                     col_limit=self.col_limit)
-            return sharded_bit_init(self.mesh, self.config.rows,
-                                    self.cols_eff, seed,
-                                    col_limit=self.col_limit)
-        if initial is not None:
-            return _put_initial(self.mesh, initial, self.config.rows,
+            else:
+                grid = sharded_bit_init(self.mesh, self.config.rows,
+                                        self.cols_eff, seed,
+                                        col_limit=self.col_limit)
+        elif initial is not None:
+            grid = _put_initial(self.mesh, initial, self.config.rows,
                                 self.config.cols, False)
-        return sharded_init(self.mesh, self.config.rows, self.config.cols,
-                            seed)
+        else:
+            grid = sharded_init(self.mesh, self.config.rows,
+                                self.config.cols, seed)
+        if self.sparse_plan is not None:
+            from mpi_tpu.ops.activity import initial_state
+
+            return initial_state(grid, self.sparse_plan)
+        return grid
+
+    def raw_grid(self, grid):
+        """The bare device array behind a step-state (identity on dense
+        engines; unwraps the SparseState of a sparse engine) — for
+        callers that need array attributes (shards, shape)."""
+        if self.sparse_plan is not None:
+            return grid.grid
+        return grid
+
+    def sparse_stats(self, grid) -> Optional[dict]:
+        """Activity readout (active_tiles/active_fraction/mode) of a
+        sparse engine's state; None on dense engines.  Costs a tiny
+        device reduce over the nti x ntj tile map plus one fetch."""
+        if self.sparse_plan is None:
+            return None
+        from mpi_tpu.ops.activity import activity_stats
+
+        return activity_stats(grid, self.sparse_plan)
 
     def ensure_compiled(self, grid, n: int):
         """The compiled executable advancing ``grid`` by ``n`` generations
@@ -493,7 +531,7 @@ class Engine:
         memoized per ``(n, B)`` with the same lock/fallback/counting
         discipline (``compile_count`` covers both tables — the serve
         layer's zero-recompile assertions read one counter)."""
-        key = (n, int(grids.shape[0]))
+        key = (n, _batch_width(grids))
         c = self._compiled_batched.get(key)
         if c is not None:
             return c
@@ -561,6 +599,14 @@ class Engine:
             def evolve_batched(grids, steps: int):
                 return jax.vmap(lambda g: base(g, steps))(grids)
 
+            if self.sparse_plan is not None:
+                from mpi_tpu.ops import activity
+                # the vmapped program embeds the sparse evolve, whose
+                # persistent-cache deserialization corrupts the heap on
+                # jaxlib 0.4.37 XLA:CPU — suppress writes so a same-salt
+                # (same-process) rebuild can never read one back (see
+                # activity._CACHE_SALT)
+                evolve_batched = activity._UncachedEvolve(evolve_batched)
             self._evolve_batched = evolve_batched
         return self._evolve_batched
 
@@ -611,13 +657,20 @@ class Engine:
 
     def stack_grids(self, grids):
         """One ``[B, ...]`` device batch from B per-board grids (a single
-        fused dispatch, not B copies; jit retraces per batch width)."""
+        fused dispatch, not B copies; jit retraces per batch width).
+        Sparse engines stack the whole SparseState pytree leaf-wise
+        (single-device by construction, so no out_shardings needed)."""
         import jax.numpy as jnp
 
         if self._stack_fn is None:
-            self._stack_fn = jax.jit(
-                lambda gs: jnp.stack(gs), out_shardings=self.batched_sharding()
-            )
+            if self.sparse_plan is not None:
+                self._stack_fn = jax.jit(lambda gs: jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *gs))
+            else:
+                self._stack_fn = jax.jit(
+                    lambda gs: jnp.stack(gs),
+                    out_shardings=self.batched_sharding()
+                )
         return self._stack_fn(list(grids))
 
     def unstack_grids(self, batched):
@@ -627,10 +680,19 @@ class Engine:
         from mpi_tpu.parallel.step import grid_sharding
 
         if self._unstack_fn is None:
-            self._unstack_fn = jax.jit(
-                lambda b: tuple(b[i] for i in range(b.shape[0])),
-                out_shardings=grid_sharding(self.mesh),
-            )
+            if self.sparse_plan is not None:
+                def _unstack(b):
+                    B = jax.tree_util.tree_leaves(b)[0].shape[0]
+                    return tuple(
+                        jax.tree_util.tree_map(lambda x: x[i], b)
+                        for i in range(B))
+
+                self._unstack_fn = jax.jit(_unstack)
+            else:
+                self._unstack_fn = jax.jit(
+                    lambda b: tuple(b[i] for i in range(b.shape[0])),
+                    out_shardings=grid_sharding(self.mesh),
+                )
         return list(self._unstack_fn(batched))
 
     def init_grids(self, seeds=None, initials=None):
@@ -670,9 +732,10 @@ class Engine:
         ``(plan_signature, B)``; compiled executables still memoize here
         per ``(n, B)``, so a cache hit costs zero new XLA compiles."""
         def step(grids, n):
-            if int(grids.shape[0]) != B:
+            got = _batch_width(grids)
+            if got != B:
                 raise ValueError(
-                    f"batched stepper built for B={B}, got {grids.shape[0]}")
+                    f"batched stepper built for B={B}, got {got}")
             return self.step_batched(grids, n)
 
         step.B = B
@@ -689,6 +752,7 @@ class Engine:
     def tiles(self, grid):
         """Snapshot tiles ``(pid, tile, r0, c0)`` for every addressable
         shard (the np.asarray fetches inside are the real barrier)."""
+        grid = self.raw_grid(grid)
         up = self._get_unpacker()
         return _shard_tiles(up(grid) if up is not None else grid,
                             col_limit=self.col_limit)
@@ -699,7 +763,7 @@ class Engine:
         the global array — snapshot tiles are the multi-host output)."""
         if jax.process_count() > 1:
             return None
-        final = np.asarray(jax.device_get(grid))
+        final = np.asarray(jax.device_get(self.raw_grid(grid)))
         if self.bitpacked:
             from mpi_tpu.ops.bitlife import unpack_np
 
@@ -715,6 +779,7 @@ class Engine:
         import jax.numpy as jnp
         from jax import lax
 
+        grid = self.raw_grid(grid)
         if self.bitpacked:
             per_row = jnp.sum(
                 lax.population_count(grid).astype(jnp.uint32), axis=1)
@@ -729,6 +794,7 @@ class Engine:
         import jax.numpy as jnp
         from jax import lax
 
+        grids = self.raw_grid(grids)
         if self.bitpacked:
             per_row = jnp.sum(
                 lax.population_count(grids).astype(jnp.uint32), axis=2)
@@ -743,7 +809,7 @@ class Engine:
         as :meth:`fetch`)."""
         if jax.process_count() > 1:
             return None
-        final = np.asarray(jax.device_get(grids))
+        final = np.asarray(jax.device_get(self.raw_grid(grids)))
         if self.bitpacked:
             from mpi_tpu.ops.bitlife import unpack_np
 
@@ -965,10 +1031,63 @@ def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:
             )
         return _wrap_seam(ev)
 
+    # Activity-gated sparse stepping (ops/activity.py): wrap whichever
+    # evolve won the dispatch above in the dirty-tile gate.  The wrapper
+    # is engine-agnostic — it needs the base evolve (its dense branch),
+    # a tile-local step (haloed block -> stepped interior) and the tile
+    # geometry; everything downstream (segment tables, batching, seam of
+    # the compile fallback) sees one ordinary evolve over a SparseState.
+    sparse_plan = None
+    if config.sparse_tile:
+        from mpi_tpu.ops import activity
+
+        T = config.sparse_tile
+        bitp = packed_mode or bool(ltl_mode)
+        if mi * mj != 1:
+            raise ConfigError(
+                f"sparse_tile requires a single-device mesh (got "
+                f"{mi}x{mj}); shard OR activity-gate, not both yet")
+        if bitp and T % WORD != 0:
+            raise ConfigError(
+                f"sparse_tile {T} must be a multiple of {WORD} on the "
+                f"packed engines (tiles are expressed in words); use a "
+                f"multiple of {WORD} or a rule/width that takes the "
+                f"dense engine")
+        if pad_bits:
+            raise ConfigError(
+                f"sparse_tile on a pad-to-32 width ({config.cols} cols) "
+                f"is unsupported; use a word-aligned width")
+        if packed_mode:
+            from mpi_tpu.ops.bitlife import bit_step as _local_full
+        elif ltl_mode:
+            from mpi_tpu.ops.bitltl import ltl_step as _local_full
+        else:
+            from mpi_tpu.ops.stencil import step as _local_full
+        sparse_plan = activity.make_plan(
+            rows=config.rows,
+            cols_units=(cols_eff // WORD) if bitp else config.cols,
+            tile_px=T, radius=config.rule.radius,
+            periodic=(config.boundary == "periodic"), packed=bitp,
+        )
+        def sparse_local(strip):
+            # one dead-boundary kernel call over the stacked haloed tiles;
+            # activity.py slices the interiors out (cross-tile bleed in
+            # the strip only reaches halo rows, which it discards)
+            return _local_full(strip, config.rule, "dead")
+
+        evolve = activity.make_sparse_evolve(evolve, sparse_local,
+                                             sparse_plan)
+        _base_fallback = fallback_factory
+
+        def fallback_factory():
+            return activity.make_sparse_evolve(
+                _base_fallback(), sparse_local, sparse_plan)
+
     return Engine(
         config, mesh, evolve, bitpacked=packed_mode or bool(ltl_mode),
         cols_eff=cols_eff, pad_bits=pad_bits, used_pallas=used_pallas,
         fallback_factory=fallback_factory, notes=notes,
+        sparse_plan=sparse_plan,
     )
 
 
@@ -1009,8 +1128,9 @@ def run_tpu(
 
     # Timed regions must close with a real fetch, not block_until_ready
     # (see force_fetch); the warm call here also compiles the tiny slice
-    # executables inside the setup-timed phase.
-    force_fetch(grid)
+    # executables inside the setup-timed phase.  (raw_grid: a sparse
+    # engine's state is a pytree, force_fetch wants the array's shards.)
+    force_fetch(engine.raw_grid(grid))
     timer.setup_done()
 
     it = start_iteration
@@ -1023,7 +1143,7 @@ def run_tpu(
             # tiles' np.asarray(shard.data) fetches are the real barrier
             # here; no block_until_ready needed (or trusted)
             snapshot_cb(it, engine.tiles(grid))
-    force_fetch(grid)
+    force_fetch(engine.raw_grid(grid))
     timer.finish()
     return engine.fetch(grid)
 

@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "compute (edge bands recomputed from the halo and "
                    "stitched in; the comm/compute overlap the reference's "
                    "barrier-then-exchange loop forgoes, main.cpp:297-299)")
+    p.add_argument("--sparse", type=int, default=0, metavar="T",
+                   help="tpu backend: activity-gated sparse stepping with "
+                   "TxT dirty tiles (ops/activity.py) — skip tiles that "
+                   "provably cannot change (bit-identical; an order of "
+                   "magnitude on mostly-quiescent boards, automatic "
+                   "hysteresis fallback to dense when the board is busy). "
+                   "T must divide the grid; multiple of 32 on the packed "
+                   "engines. 0 = dense (default)")
     p.add_argument("--name", default=None, help="run name (default: timestamp)")
     p.add_argument("--strict", action="store_true",
                    help="enforce the reference's validation rules "
@@ -201,6 +209,7 @@ def _run(args) -> int:
         workers=args.workers,
         comm_every=comm_every,
         overlap=args.overlap,
+        sparse_tile=args.sparse,
     )
     if args.strict:
         # backend-independent checks (square grid, any typed --mesh) fail

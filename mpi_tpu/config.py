@@ -75,6 +75,7 @@ class GolConfig:
     workers: int = 0                 # native backend threads; 0 = auto
     comm_every: int = 1              # TPU: generations per halo exchange (1..16)
     overlap: bool = False            # TPU backend (packed or dense, either boundary): overlap ppermute with interior compute
+    sparse_tile: int = 0             # TPU: activity-gated stepping tile size in cells; 0 = dense (ops/activity.py)
 
     def __post_init__(self):
         if self.rows <= 0 or self.cols <= 0:
@@ -100,6 +101,26 @@ class GolConfig:
             raise ConfigError("comm_every > 1 requires a rule without birth-on-0")
         if self.overlap and self.backend != "tpu":
             raise ConfigError("overlap applies to the tpu backend only")
+        if self.sparse_tile < 0:
+            raise ConfigError(f"sparse_tile must be >= 0, got {self.sparse_tile}")
+        if self.sparse_tile:
+            if self.backend != "tpu":
+                raise ConfigError("sparse_tile applies to the tpu backend only")
+            if self.comm_every != 1:
+                raise ConfigError(
+                    "sparse_tile requires comm_every=1 (the dirty map is "
+                    "maintained per generation)")
+            if self.overlap:
+                raise ConfigError("sparse_tile and overlap are exclusive")
+            if self.rows % self.sparse_tile or self.cols % self.sparse_tile:
+                raise ConfigError(
+                    f"sparse_tile {self.sparse_tile} must divide the grid "
+                    f"({self.rows}x{self.cols})")
+            if self.sparse_tile < self.rule.radius:
+                raise ConfigError(
+                    f"sparse_tile {self.sparse_tile} smaller than the rule "
+                    f"radius {self.rule.radius} (one-ring dilation would "
+                    f"miss changes)")
         if self.mesh_shape is not None:
             if self.backend != "tpu":
                 # other backends would silently ignore it (cpp-par
@@ -162,6 +183,7 @@ def plan_signature(config: GolConfig, mesh_shape: Tuple[int, int],
         config.rows, config.cols, config.rule, config.boundary,
         config.backend, tuple(mesh_shape), config.comm_every,
         bool(config.overlap), tuple(sorted(set(segments))),
+        config.sparse_tile,
     )
 
 

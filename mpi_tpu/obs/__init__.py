@@ -220,6 +220,29 @@ class Obs:
         m.gauge_fn("mpi_tpu_session_cells_per_second",
                    "Per-session steady-state cell updates per second",
                    _cells_per_sec)
+
+        def _sparse_series(field):
+            # scrape-time readout of each sparse session's dirty map; a
+            # concurrent step may have donated the grid buffer out from
+            # under us (Array deleted) — skip that session this scrape
+            out = []
+            for s in manager._session_list():
+                eng = s.engine
+                if eng is None or getattr(eng, "sparse_plan", None) is None:
+                    continue
+                try:
+                    sa = eng.sparse_stats(s.grid)
+                except Exception:
+                    continue
+                out.append(({"session": s.id}, sa[field]))
+            return out
+
+        m.gauge_fn("mpi_tpu_active_tiles",
+                   "Dirty tiles the next sparse step must compute",
+                   lambda: _sparse_series("active_tiles"))
+        m.gauge_fn("mpi_tpu_active_fraction",
+                   "Active fraction of the sparse tile map (0-1)",
+                   lambda: _sparse_series("active_fraction"))
         m.counter_fn("mpi_tpu_trace_spans_total",
                      "Spans/events recorded by the tracer",
                      lambda: self.tracer.stats()["recorded"])

@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bound on tickets queued for the async dispatch "
                    "loop; an enqueue beyond it answers a structured 503 "
                    "(backpressure, not an error)")
+    p.add_argument("--ticket-ttl-s", type=float, default=600.0,
+                   help="seconds a RESOLVED async ticket stays "
+                   "resolvable via GET /result/<ticket> before aging "
+                   "out (0 keeps tickets until the 4096-entry size cap "
+                   "evicts them; pending tickets never expire this way)")
     p.add_argument("--verbose", action="store_true",
                    help="log one line per HTTP request (with request ids)")
     p.add_argument("--state-dir", default=None,
@@ -131,6 +136,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             batch_max=args.batch_max,
             async_enabled=not args.no_async,
             async_queue_max=args.async_queue_max,
+            ticket_ttl_s=args.ticket_ttl_s,
             state_dir=args.state_dir,
             checkpoint_every=args.checkpoint_every,
             request_timeout_s=args.request_timeout_s,

@@ -77,7 +77,7 @@ from mpi_tpu.utils.hashinit import init_tile_np
 
 _SPEC_KEYS = {
     "rows", "cols", "rule", "boundary", "backend", "seed", "comm_every",
-    "overlap", "mesh", "segments",
+    "overlap", "mesh", "segments", "sparse_tile",
 }
 
 
@@ -150,6 +150,7 @@ def _parse_spec(spec: dict):
         mesh_shape=mesh,
         comm_every=int(spec.get("comm_every", 1)),
         overlap=bool(spec.get("overlap", False)),
+        sparse_tile=int(spec.get("sparse_tile", 0)),
     )
     return config, segments
 
@@ -284,6 +285,7 @@ class SessionManager:
                  batch_max: int = 8,
                  async_enabled: bool = True,
                  async_queue_max: int = 1024,
+                 ticket_ttl_s: float = 600.0,
                  state_dir: Optional[str] = None,
                  checkpoint_every: int = 64,
                  request_timeout_s: Optional[float] = None,
@@ -303,7 +305,8 @@ class SessionManager:
         # first enqueue, so a sync-only workload never runs it.
         self.dispatcher = (
             AsyncDispatcher(self, window_s=max(0.0, batch_window_ms) / 1e3,
-                            queue_max=async_queue_max)
+                            queue_max=async_queue_max,
+                            ticket_ttl_s=ticket_ttl_s)
             if async_enabled else None
         )
         self._sessions: Dict[str, Session] = {}
@@ -807,6 +810,16 @@ class SessionManager:
                               sid=session.id, steps=steps,
                               block_s=round(t2 - td, 9))
                 obs.dispatch_solo.observe(t2 - t1)
+                if session.engine.sparse_plan is not None:
+                    # activity readout AFTER the sync (tiny tile-map
+                    # reduce + fetch) — the span every sparse dispatch
+                    # leaves in the trace
+                    sa = session.engine.sparse_stats(session.grid)
+                    obs.event("sparse_step", 0.0, t2, sid=session.id,
+                              active_tiles=sa["active_tiles"],
+                              active_fraction=round(
+                                  sa["active_fraction"], 6),
+                              mode=sa["mode"])
             self._mark_dispatch_ok()
         else:
             t0 = time.perf_counter()
@@ -951,6 +964,8 @@ class SessionManager:
                 d["engine_batched_compiles"] = engine.batched_compile_count
                 d["engine_notes"] = list(engine.notes)
                 d["batched_steps"] = session.batched_steps
+                if engine.sparse_plan is not None:
+                    d["sparse"] = engine.sparse_stats(session.grid)
             if session.degraded:
                 d["degraded"] = True
                 d["degraded_reason"] = session.degraded_reason

@@ -19,13 +19,16 @@ its queues on ``(plan_signature, depth)``, so a depth-3 and a depth-1
 request never share a dispatch.  The dispatch loop instead decomposes a
 depth-k ticket into k *unit steps* scheduled round-by-round: each round
 takes the head ticket of every session, groups the engine-backed heads
-by engine, and advances each group ``r = min(remaining)`` generations
-as a chain of depth-1 dispatches — stacked ``[B, ...]`` vmapped ones
-when B >= 2 (``Engine.step_batched`` at depth 1), a donation-safe
-``Engine.step_units`` chain when alone — with ONE sync at the end of
-the chain.  Mixed-depth sessions therefore share dispatches for as long
-as their remaining depths overlap: occupancy is bounded by concurrency,
-not depth agreement, and only the depth-1 executables (the one depth
+by engine, and advances each group through a **cohort-chunked chain**
+of depth-1 dispatches: boards sorted by remaining depth advance
+together — stacked ``[B, ...]`` vmapped dispatches when B >= 2
+(``Engine.step_batched`` at depth 1), a donation-safe
+``Engine.step_units`` chain when alone — up to the shallowest cohort's
+depth, finished lanes peel off, and the narrower stack continues, with
+ONE sync at the end of the whole chain.  Mixed-depth sessions therefore
+share dispatches for as long as their remaining depths overlap, every
+head ticket finishes its full depth in one round (a {1, 16} mix costs
+one sync, not sixteen), and only the depth-1 executables (the one depth
 every session precompiles) are ever needed.
 
 In-order completion per session is structural: one dispatch loop, one
@@ -105,13 +108,19 @@ class AsyncDispatcher:
     """
 
     def __init__(self, manager, window_s: float = 0.002,
-                 queue_max: int = 1024, retain: int = 4096):
+                 queue_max: int = 1024, retain: int = 4096,
+                 ticket_ttl_s: float = 600.0):
         self.manager = manager
         self.window_s = max(0.0, float(window_s))
         if queue_max < 1:
             raise ValueError(f"async queue_max must be >= 1, got {queue_max}")
         self.queue_max = int(queue_max)
+        # resolved-ticket retention: a resolved ticket stays resolvable
+        # for ticket_ttl_s seconds (0 disables the clock), with `retain`
+        # as the hard size cap either way — bursty small-ticket traffic
+        # is bounded by BOTH time and count, not count alone
         self.retain = max(1, int(retain))
+        self.ticket_ttl_s = max(0.0, float(ticket_ttl_s))
         self._cv = threading.Condition()
         self._inbox: List[Ticket] = []              # enqueued, unadmitted
         self._per_session: Dict[str, List[Ticket]] = {}     # admitted FIFO
@@ -191,7 +200,8 @@ class AsyncDispatcher:
 
     def stats(self) -> dict:
         with self._cv:
-            rounds = self.unit_rounds
+            self._evict_locked()        # TTL fires on scrape too, so an
+            rounds = self.unit_rounds   # idle server still sheds tickets
             return {
                 "queue_depth": (len(self._inbox)
                                 + sum(len(q)
@@ -211,6 +221,8 @@ class AsyncDispatcher:
                 "batched_fallbacks": self.batched_fallbacks,
                 "window_ms": self.window_s * 1e3,
                 "queue_max": self.queue_max,
+                "ticket_ttl_s": self.ticket_ttl_s,
+                "tickets_retained": len(self._done_order),
             }
 
     def reset_stats(self) -> None:
@@ -238,12 +250,22 @@ class AsyncDispatcher:
             self.tickets_completed += 1
             self._completed_by_sid[ticket.sid] = (
                 self._completed_by_sid.get(ticket.sid, 0) + 1)
-            self._done_order.append(ticket.id)
-            # bound the table: the oldest RESOLVED tickets age out; a
-            # pending ticket is never evicted (its id must resolve)
-            while len(self._done_order) > self.retain:
-                self._tickets.pop(self._done_order.popleft(), None)
+            self._done_order.append((ticket.id, ticket.done_mono))
+            self._evict_locked()
         ticket.event.set()
+
+    def _evict_locked(self) -> None:
+        """Age out the oldest RESOLVED tickets: anything beyond the
+        ``retain`` size cap, plus anything older than ``ticket_ttl_s``
+        (0 = no clock).  A pending ticket is never evicted — its id must
+        resolve.  Caller holds ``_cv``."""
+        cutoff = (time.monotonic() - self.ticket_ttl_s
+                  if self.ticket_ttl_s else None)
+        while self._done_order and (
+                len(self._done_order) > self.retain
+                or (cutoff is not None and self._done_order[0][1] <= cutoff)):
+            tid, _ = self._done_order.popleft()
+            self._tickets.pop(tid, None)
 
     # -- the dispatch loop -------------------------------------------------
 
@@ -331,12 +353,17 @@ class AsyncDispatcher:
             self._run_solo(t)
 
     def _run_group(self, group) -> List[Ticket]:
-        """One unit-round chain for the head tickets sharing an engine:
-        advance every board ``r = min(remaining)`` generations through
-        chained depth-1 dispatches (stacked when B >= 2), ONE sync at
-        the end, then commit.  Returns the tickets that must fall back
-        to the solo path (run by the caller AFTER the session locks here
-        are released — the solo path takes them itself)."""
+        """One cohort-chunked chain for the head tickets sharing an
+        engine: boards sorted by remaining depth advance together in
+        stacked depth-1 dispatches up to the shallowest cohort's depth,
+        finished lanes peel off, and the narrower stack continues —
+        every head ticket completes in ONE chain with ONE sync at the
+        end.  (The previous ``r = min(remaining)`` round rule made a
+        {1, 16} depth mix re-sync for every depth-1 arrival — 16 syncs
+        for the deep ticket; cohort lookahead keeps it at one per
+        round.)  Returns the tickets that must fall back to the solo
+        path (run by the caller AFTER the session locks here are
+        released — the solo path takes them itself)."""
         import jax
 
         from mpi_tpu.serve.session import (
@@ -363,8 +390,11 @@ class AsyncDispatcher:
                     if not (s.closed or s.engine is None)]
             if not live:
                 return []
+            # ascending remaining depth = the cohort peel order
+            live.sort(key=lambda ts: (ts[0].remaining, ts[1].id))
             B = len(live)
-            r = min(t.remaining for t, _ in live)
+            rem = [t.remaining for t, _ in live]
+            chain = rem[-1]             # deepest cohort = chain length
             sig = live[0][1].plan_sig
             t1 = time.perf_counter()
 
@@ -372,21 +402,47 @@ class AsyncDispatcher:
                 if B == 1:
                     s = live[0][1]
                     s.engine.ensure_compiled(s.grid, 1)
-                    g = engine.step_units(s.grid, r)
+                    g = engine.step_units(s.grid, rem[0])
                     jax.block_until_ready(g)
                     return [g]
-                stepper, _hit = manager.cache.get_or_build_batched(
-                    sig, B, lambda: engine.batched_stepper(B))
-                stacked = engine.stack_grids([s.grid for _, s in live])
-                engine.ensure_compiled_batched(stacked, 1)
-                for _ in range(r):
-                    stacked = stepper(stacked, 1)
-                jax.block_until_ready(stacked)
-                return engine.unstack_grids(stacked)
+                finals = [None] * B
+                grids = [s.grid for _, s in live]
+                lanes = list(range(B))  # still running, ascending rem
+                done = 0                # generations advanced so far
+                while lanes:
+                    target = rem[lanes[0]]
+                    if len(lanes) == 1:
+                        i = lanes[0]
+                        engine.ensure_compiled(grids[i], 1)
+                        grids[i] = engine.step_units(grids[i],
+                                                     target - done)
+                    else:
+                        Bc = len(lanes)
+                        stepper, _hit = manager.cache.get_or_build_batched(
+                            sig, Bc,
+                            lambda Bc=Bc: engine.batched_stepper(Bc))
+                        stacked = engine.stack_grids(
+                            [grids[i] for i in lanes])
+                        engine.ensure_compiled_batched(stacked, 1)
+                        for _ in range(target - done):
+                            stacked = stepper(stacked, 1)
+                        for i, g in zip(lanes,
+                                        engine.unstack_grids(stacked)):
+                            grids[i] = g
+                    done = target
+                    nxt = []
+                    for i in lanes:
+                        if rem[i] == done:
+                            finals[i] = grids[i]
+                        else:
+                            nxt.append(i)
+                    lanes = nxt
+                jax.block_until_ready(finals)
+                return finals
 
             try:
                 boards = _watchdog_call(work, deadline,
-                                        f"unit_round[B={B},r={r}]")
+                                        f"unit_round[B={B},chain={chain}]")
             except Exception as e:  # noqa: BLE001 — solo fallback decides
                 manager._engine_failure(live[0][1], sig, e,
                                         timeout=isinstance(e, DeadlineError))
@@ -395,7 +451,8 @@ class AsyncDispatcher:
                 return [t for t, _ in live]
             t2 = time.perf_counter()
             if obs is not None:
-                obs.event("unit_round", t2 - t1, t1, B=B, rounds=r,
+                obs.event("unit_round", t2 - t1, t1, B=B, rounds=chain,
+                          cohorts=len(set(rem)),
                           sids=[s.id for _, s in live],
                           request_ids=[t.rid for t, _ in live])
                 obs.occupancy_series.observe(B)
@@ -403,8 +460,9 @@ class AsyncDispatcher:
                  else obs.dispatch_solo).observe(t2 - t1)
             per_board = (t2 - t1) / B
             for (t, s), grid in zip(live, boards):
+                adv = t.remaining       # cohort chains run to completion
                 s.grid = grid
-                s.generation += r
+                s.generation += adv
                 s.steady_s += per_board
                 if B > 1:
                     s.batched_steps += 1
@@ -415,21 +473,20 @@ class AsyncDispatcher:
                     manager._checkpoint(s)
                 finally:
                     reset_request_id(token)
-                t.remaining -= r
-                t.unit_rounds += r
+                t.remaining = 0
+                t.unit_rounds += adv
                 t.max_batched = max(t.max_batched, B if B > 1 else 0)
-                if t.remaining == 0:
-                    self._complete(t, result={
-                        "id": s.id, "generation": s.generation,
-                        "steps": t.steps, "async": True,
-                        "unit_rounds": t.unit_rounds,
-                        "max_batched": t.max_batched})
+                self._complete(t, result={
+                    "id": s.id, "generation": s.generation,
+                    "steps": t.steps, "async": True,
+                    "unit_rounds": t.unit_rounds,
+                    "max_batched": t.max_batched})
             manager._mark_dispatch_ok()
             manager.cache.record_success(sig)
             with self._cv:
                 self.group_dispatches += 1
-                self.unit_rounds += r
-                self.board_rounds += B * r
+                self.unit_rounds += chain
+                self.board_rounds += sum(rem)
                 self.max_occupancy = max(self.max_occupancy, B)
             return []
         finally:

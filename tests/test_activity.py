@@ -1,0 +1,214 @@
+"""Activity-gated sparse stepping (ISSUE 6): the ``sparse_tile`` engine
+must be bit-identical to the dense engine it wraps — for every rule /
+boundary / tile-size combination, through both the depth-1 serving path
+(``step_units`` chains) and the deep phase-pipeline dispatch, across
+sparse→dense hysteresis crossings and back.  Plus the behaviors the
+dirty-tile gate exists for: a lone glider keeps the active set tiny
+(and wraps the periodic seam), a dying board drains to zero active
+tiles, and activity re-ignites a quiescent neighbor tile."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.backends.tpu import build_engine
+from mpi_tpu.config import ConfigError, GolConfig
+from mpi_tpu.models.rules import rule_from_name
+from mpi_tpu.utils.hashinit import init_tile_np
+
+GLIDER = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8)
+
+
+def _cfg(rows, cols, T=0, rule="life", boundary="periodic"):
+    return GolConfig(rows=rows, cols=cols, steps=0, backend="tpu",
+                     mesh_shape=(1, 1), sparse_tile=T,
+                     rule=rule_from_name(rule), boundary=boundary)
+
+
+def _run(cfg, steps, seed=7, initial=None):
+    eng = build_engine(cfg)
+    g = (eng.init_grid(initial=initial) if initial is not None
+         else eng.init_grid(seed=seed))
+    g = eng.step(g, steps)
+    return eng, g, np.asarray(eng.fetch(g))
+
+
+# -- parity fuzz: rules x boundaries x tile sizes -------------------------
+
+PARITY_CASES = [
+    # (rule, rows, cols, T, steps) — life rides the packed SWAR engine,
+    # highlife the bit-sliced LtL engine, bosco (r=5) the wide-radius
+    # LtL path with T=16 (multi-word halo at depth > 6)
+    ("life", 64, 64, 32, 12),
+    ("life", 128, 128, 32, 25),
+    ("highlife", 64, 128, 32, 10),
+    ("bosco", 48, 48, 16, 6),
+]
+
+
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+@pytest.mark.parametrize("rule,rows,cols,T,steps", PARITY_CASES)
+def test_sparse_matches_dense(rule, rows, cols, T, steps, boundary):
+    _, _, dense = _run(_cfg(rows, cols, 0, rule, boundary), steps)
+    es, gs, sparse = _run(_cfg(rows, cols, T, rule, boundary), steps)
+    np.testing.assert_array_equal(
+        sparse, dense,
+        err_msg=f"{rule} {rows}x{cols} T={T} {boundary} steps={steps}")
+    # and against the host oracle, so a shared dense-engine bug can't
+    # hide the sparse one
+    ref = evolve_np(init_tile_np(rows, cols, seed=7), steps,
+                    rule_from_name(rule), boundary)
+    np.testing.assert_array_equal(sparse, ref)
+    assert es.sparse_plan is not None
+    st = es.sparse_stats(gs)
+    assert st["tile"] == T and 0.0 <= st["active_fraction"] <= 1.0
+
+
+def test_sparse_unit_chain_matches_deep_dispatch():
+    # the serving path dispatches depth-1 chains; the CLI path one deep
+    # phase-pipeline call — same generations, same bits
+    cfg = _cfg(128, 128, 32)
+    eng = build_engine(cfg)
+    a = eng.init_grid(seed=11)
+    b = eng.init_grid(seed=11)
+    for _ in range(17):
+        a = eng.step(a, 1)
+    b = eng.step(b, 17)
+    np.testing.assert_array_equal(np.asarray(eng.fetch(a)),
+                                  np.asarray(eng.fetch(b)))
+
+
+# -- behaviors the gate exists for ---------------------------------------
+
+def _glider_board(n=512):
+    board = np.zeros((n, n), dtype=np.uint8)
+    board[100:103, n - 6:n - 3] = GLIDER   # near the right seam: wraps
+    return board
+
+
+def test_glider_crossing_tiles_and_periodic_seam():
+    board = _glider_board()
+    dn = build_engine(_cfg(512, 512))
+    sp = build_engine(_cfg(512, 512, 32))
+    gd, gs = dn.init_grid(initial=board), sp.init_grid(initial=board)
+    for _ in range(120):
+        gd, gs = dn.step(gd, 1), sp.step(gs, 1)
+    np.testing.assert_array_equal(np.asarray(dn.fetch(gd)),
+                                  np.asarray(sp.fetch(gs)))
+    st = sp.sparse_stats(gs)
+    # a lone glider dirties at most one tile plus its ring
+    assert st["mode"] == "sparse" and st["active_tiles"] <= 9
+
+
+def test_glider_deep_dispatch():
+    board = _glider_board()
+    _, _, dense = _run(_cfg(512, 512), 50, initial=board)
+    _, _, sparse = _run(_cfg(512, 512, 32), 50, initial=board)
+    np.testing.assert_array_equal(sparse, dense)
+
+
+def test_full_board_death_drains_active_tiles():
+    board = np.zeros((64, 64), dtype=np.uint8)
+    board[10, 10:12] = 1                   # a domino dies in one step
+    sp = build_engine(_cfg(64, 64, 32))
+    dn = build_engine(_cfg(64, 64))
+    gs, gd = sp.init_grid(initial=board), dn.init_grid(initial=board)
+    for _ in range(40):
+        gs, gd = sp.step(gs, 1), dn.step(gd, 1)
+    np.testing.assert_array_equal(np.asarray(sp.fetch(gs)),
+                                  np.asarray(dn.fetch(gd)))
+    st = sp.sparse_stats(gs)
+    assert st["active_tiles"] == 0 and st["mode"] == "sparse"
+    assert not np.asarray(sp.fetch(gs)).any()
+
+
+def test_reignition_of_dead_neighbor_tile():
+    # a blinker straddling the tile boundary at row 32 re-activates the
+    # neighboring tile every other generation — the one-ring dilation
+    # must keep both tiles hot or the phase flips wrong
+    board = np.zeros((128, 128), dtype=np.uint8)
+    board[31, 30:33] = 1
+    sp = build_engine(_cfg(128, 128, 32))
+    dn = build_engine(_cfg(128, 128))
+    gs, gd = sp.init_grid(initial=board), dn.init_grid(initial=board)
+    for _ in range(33):
+        gs, gd = sp.step(gs, 1), dn.step(gd, 1)
+    np.testing.assert_array_equal(np.asarray(sp.fetch(gs)),
+                                  np.asarray(dn.fetch(gd)))
+
+
+def test_batched_sparse_parity_and_population():
+    boards = []
+    for k in range(3):
+        b = np.zeros((64, 64), dtype=np.uint8)
+        b[8 * k:8 * k + 3, 40:43] = GLIDER
+        boards.append(b)
+    eng = build_engine(_cfg(64, 64, 32))
+    batch = eng.stack_grids([eng.init_grid(initial=b) for b in boards])
+    outs = eng.unstack_grids(eng.step_batched(batch, 9))
+    for k, b in enumerate(boards):
+        solo = eng.step(eng.init_grid(initial=b), 9)
+        np.testing.assert_array_equal(np.asarray(eng.fetch(solo)),
+                                      np.asarray(eng.fetch(outs[k])))
+    pops = eng.population_batched(
+        eng.stack_grids([eng.init_grid(initial=b) for b in boards]))
+    assert list(np.asarray(pops)) == [5, 5, 5]
+
+
+# -- unit tests: plan geometry and the dirty-map algebra ------------------
+
+def test_make_plan_geometry():
+    from mpi_tpu.ops.activity import DEPTH_TARGET, make_plan
+
+    p = make_plan(rows=256, cols_units=8, tile_px=32, radius=1,
+                  periodic=True, packed=True)
+    assert (p.nti, p.ntj, p.ntiles) == (8, 8, 64)
+    assert p.tile_c == 1 and p.cell_cols_per_unit == 32
+    assert p.gens == DEPTH_TARGET and p.halo_r == DEPTH_TARGET
+    assert p.halo_c == 1                  # 8*1 bits pack into one word
+    assert p.capacities == tuple(sorted(p.capacities))
+    assert p.release_tiles <= p.capacity
+    # wide radius: gens capped so s*r stays within one tile ring
+    q = make_plan(rows=48, cols_units=48, tile_px=16, radius=5,
+                  periodic=False, packed=False)
+    assert q.gens == 3 and q.halo_r == 15 and q.halo_c == 15
+    # explicit depth override wins (still capped)
+    d = make_plan(rows=256, cols_units=8, tile_px=32, radius=1,
+                  periodic=True, packed=True, depth=2)
+    assert d.gens == 2 and d.halo_r == 2
+
+
+def test_dilate_tiles_dead_vs_periodic():
+    import jax.numpy as jnp
+
+    from mpi_tpu.ops.activity import active_count, dilate_tiles
+
+    changed = jnp.zeros((4, 4), dtype=jnp.bool_).at[0, 0].set(True)
+    dead = np.asarray(dilate_tiles(changed, periodic=False))
+    assert dead.sum() == 4                # corner: itself + 3 neighbors
+    assert dead[:2, :2].all() and not dead[3].any()
+    per = np.asarray(dilate_tiles(changed, periodic=True))
+    assert per.sum() == 9                 # wraps both seams
+    assert per[3, 3] and per[0, 3] and per[3, 0]
+    assert int(active_count(changed, periodic=True)) == 9
+
+
+def test_tile_changed_map_exact():
+    import jax.numpy as jnp
+
+    from mpi_tpu.ops.activity import make_plan, tile_changed_map
+
+    plan = make_plan(rows=64, cols_units=64, tile_px=32, radius=1,
+                     periodic=False, packed=False)
+    old = jnp.zeros((64, 64), dtype=jnp.uint8)
+    new = old.at[40, 10].set(1)           # tile (1, 0) only
+    m = np.asarray(tile_changed_map(new, old, plan))
+    assert m.shape == (2, 2) and m[1, 0] and m.sum() == 1
+
+
+def test_sparse_tile_validation():
+    with pytest.raises(ConfigError):
+        _cfg(64, 64, 48)                  # 48 does not divide 64
+    with pytest.raises(ConfigError):
+        GolConfig(rows=64, cols=64, steps=0, backend="serial",
+                  sparse_tile=32)         # tpu-only knob

@@ -154,6 +154,58 @@ def test_unit_chain_needs_no_new_compiles():
                           _oracle(64, 64, 71, 5))
 
 
+def test_pathological_depth_mix_one_sync_per_round():
+    """The cohort-lookahead regression: a {1, 16} depth mix must run as
+    ONE cohort-chunked chain (one group dispatch, one sync), not sixteen
+    min(remaining) rounds — and both boards stay oracle-identical."""
+    mgr = SessionManager(EngineCache(max_size=4), batch_window_ms=50.0)
+    depths = [1, 16]
+    sids = [mgr.create(dict(TPU_SPEC, seed=80 + i))["id"]
+            for i in range(len(depths))]
+    tickets = [mgr.step_async(s, d) for s, d in zip(sids, depths)]
+    outs = [_resolve(mgr, t) for t in tickets]
+    for i, (sid, d, out) in enumerate(zip(sids, depths, outs)):
+        assert out["result"]["generation"] == d
+        assert np.array_equal(_grid_of(mgr.snapshot(sid)),
+                              _oracle(64, 64, 80 + i, d)), \
+            f"cohort-chunked parity broke for sid={sid} depth={d}"
+    # the shallow ticket rode the wide first chunk
+    assert outs[1]["result"]["max_batched"] >= 2
+    st = mgr.stats()["async"]
+    assert st["group_dispatches"] == 1, \
+        f"expected ONE cohort chain, got {st['group_dispatches']} syncs"
+    assert st["unit_rounds"] == 16      # chain length = deepest cohort
+    assert st["board_rounds"] == 17     # 1 + 16 board-generations
+
+
+def test_resolved_ticket_ttl_retention():
+    """TTL-based resolved-ticket retention: a resolved ticket stays
+    resolvable inside its TTL, ages out of the table after it (404 on
+    re-read), and pending tickets are never TTL-evicted."""
+    mgr = SessionManager(EngineCache(max_size=4), ticket_ttl_s=0.2)
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial"})["id"]
+    t = mgr.step_async(sid, 1)
+    out = _resolve(mgr, t)
+    assert out["status"] == "done"
+    assert mgr.ticket_result(t["ticket"])["status"] == "done"
+    st = mgr.stats()["async"]
+    assert st["ticket_ttl_s"] == 0.2 and st["tickets_retained"] >= 1
+    time.sleep(0.3)
+    # eviction fires on the stats scrape (and on later completions)
+    assert mgr.stats()["async"]["tickets_retained"] == 0
+    with pytest.raises(KeyError):
+        mgr.ticket_result(t["ticket"])
+    # ttl=0 disables the clock: size cap only
+    mgr2 = SessionManager(EngineCache(max_size=4), ticket_ttl_s=0.0)
+    sid2 = mgr2.create({"rows": 16, "cols": 16,
+                        "backend": "serial"})["id"]
+    t2 = mgr2.step_async(sid2, 1)
+    _resolve(mgr2, t2)
+    time.sleep(0.25)
+    assert mgr2.stats()["async"]["tickets_retained"] == 1
+    assert mgr2.ticket_result(t2["ticket"])["status"] == "done"
+
+
 def test_sync_and_async_interleave_consistently():
     """Sync steps and tickets against the same board serialize through
     the session lock; the final board equals the oracle at the summed

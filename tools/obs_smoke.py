@@ -6,7 +6,9 @@ on any schema drift — missing metric families (now including the ticket
 gauges), non-monotone histogram buckets, malformed trace records, a
 request whose lifecycle cannot be reconstructed by its shared request
 id, a missing async span kind (``enqueue``/``ticket_wait``/
-``unit_round``), or a ticket that does not resolve exactly once.
+``unit_round``), a ticket that does not resolve exactly once, or a
+sparse-engine session whose activity gauges (``mpi_tpu_active_tiles``/
+``mpi_tpu_active_fraction``) or ``sparse_step`` trace events drift.
 
 This is the contract check for PR 4's tentpole: dashboards and trace
 tooling parse these two text formats, so their shape is API.  Run
@@ -49,9 +51,13 @@ REQUIRED_METRICS = [
     "mpi_tpu_tickets_pending",
     "mpi_tpu_tickets_completed_total",
     "mpi_tpu_unit_rounds_total",
+    "mpi_tpu_active_tiles",
+    "mpi_tpu_active_fraction",
 ]
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
+# ...and the sparse-engine step path (PR 6)
+SPARSE_SPAN_KINDS = {"sparse_step"}
 # every trace record must carry exactly these core keys
 TRACE_KEYS = {"seq", "name", "t_unix", "t_mono", "dur_s", "thread"}
 
@@ -118,12 +124,14 @@ def check_histograms(types, samples):
                 f"({counts.get((base, lk))})")
 
 
-def check_trace(path, require_async=False):
+def check_trace(path, require_async=False, require_sparse=False):
     """Every JSONL record well-formed; at least one http_request span
     shares its rid with a dispatch span (lifecycle reconstructable).
     ``require_async`` additionally demands the PR-5 span kinds — set by
     the smoke's own traffic (which drives tickets); importers checking
-    async-free traffic leave it off."""
+    async-free traffic leave it off.  ``require_sparse`` likewise
+    demands the PR-6 ``sparse_step`` activity event (emitted by every
+    solo step of a ``sparse_tile`` session) carrying its gauge fields."""
     recs = []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
@@ -154,6 +162,19 @@ def check_trace(path, require_async=False):
         if missing_kinds:
             raise ValueError(f"trace missing async span kinds: "
                              f"{sorted(missing_kinds)}")
+    if require_sparse:
+        sparse = [r for r in recs if r["name"] in SPARSE_SPAN_KINDS]
+        if not sparse:
+            raise ValueError("trace missing sparse span kinds: "
+                             f"{sorted(SPARSE_SPAN_KINDS)}")
+        for r in sparse:
+            missing = {"active_tiles", "active_fraction", "mode"} - r.keys()
+            if missing:
+                raise ValueError(
+                    f"sparse_step event missing {sorted(missing)}: {r}")
+            if not 0.0 <= r["active_fraction"] <= 1.0:
+                raise ValueError(f"sparse_step active_fraction out of "
+                                 f"range: {r}")
     return len(recs), len(linked)
 
 
@@ -258,6 +279,22 @@ def main():
                     f"ticket {tid} did not resolve exactly once: "
                     f"first {results[tid]}, re-read {again}")
 
+        # -- sparse activity gauges: one sparse_tile session (PR 6) ----
+        # solo-signature steps so each dispatch emits a sparse_step
+        # trace event; the gauge families read the live dirty map at
+        # scrape time, labeled by session
+        _, body = call("POST", "/sessions",
+                       {"rows": 64, "cols": 64, "backend": "tpu",
+                        "mesh": "1x1", "sparse_tile": 32})
+        sid_s = json.loads(body)["id"]
+        step(sid_s)
+        step(sid_s)
+        _, body = call("GET", "/stats")
+        descs = {d["id"]: d for d in json.loads(body)["sessions"]}
+        if descs[sid_s].get("sparse", {}).get("tile") != 32:
+            raise ValueError(f"/stats lacks sparse stats for {sid_s}: "
+                             f"{descs[sid_s]}")
+
         code, text = call("GET", "/metrics")   # final request; the counter
         assert code == 200, f"/metrics -> {code}"  # increments post-render
         types, samples = parse_prometheus(text)
@@ -295,12 +332,26 @@ def main():
         if not (max_depth <= unit_rounds <= total_depth):
             raise ValueError(f"unit_rounds_total = {unit_rounds}, expected "
                              f"in [{max_depth}, {total_depth}]")
+        # the sparse gauges must carry a labeled sample for the sparse
+        # session (and ONLY sparse sessions — dense ones emit nothing)
+        for fam in ("mpi_tpu_active_tiles", "mpi_tpu_active_fraction"):
+            fam_samples = {labels.get("session"): v
+                           for n, labels, v in samples if n == fam}
+            if set(fam_samples) != {sid_s}:
+                raise ValueError(f"{fam} sessions = "
+                                 f"{sorted(map(str, fam_samples))}, "
+                                 f"expected exactly [{sid_s!r}]")
+        frac = next(v for n, labels, v in samples
+                    if n == "mpi_tpu_active_fraction")
+        if not 0.0 <= frac <= 1.0:
+            raise ValueError(f"active_fraction = {frac}, expected in [0, 1]")
     finally:
         server.shutdown()
         server.server_close()
         obs.close()
 
-    n_recs, n_linked = check_trace(trace_log, require_async=True)
+    n_recs, n_linked = check_trace(trace_log, require_async=True,
+                                   require_sparse=True)
     print(f"obs smoke OK: {len(samples)} metric samples, "
           f"{n_recs} trace records, {n_linked} request lifecycles linked "
           f"({trace_log})")

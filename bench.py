@@ -1212,6 +1212,210 @@ def serve_bench_async() -> None:
     print(json.dumps(out))
 
 
+def serve_bench_wire() -> None:
+    """`python bench.py --serve-wire`: the wire-protocol A/B (ISSUE 7).
+
+    Two measurements over REAL HTTP (client sockets against a live
+    server, so framing and syscalls are in the numbers):
+
+    * **snapshot encoding** — JSON rows vs the binary grid frame
+      (``Accept: application/x-gol-grid``) at 4096^2 through the
+      threaded front: bytes on the wire, wall time, decoded-equal
+      check.  The acceptance gate is >= 3x fewer bytes binary vs JSON
+      (the format is 1 bit/cell + 32 bytes, so ~8x is expected).
+    * **poller scaling** — N idle ``GET /result/<t>?wait=1`` clients
+      against the aio front.  Each parked waiter is a registered
+      socket, not a thread: the gate is N >= 10x the threads the
+      front owns (loop + workers), with blocking step throughput
+      through the aio front within 5% of the threaded front on the
+      same dispatch-bound 64x64 signature (shared EngineCache, so
+      both fronts drive the identical compiled stepper).
+
+    One JSON line; errors land in the "error" field.
+    """
+    out = {"bench": "serve_wire", "ok": False}
+    try:
+        import http.client
+        import socket as socketlib
+        import threading
+
+        from mpi_tpu.serve import wire
+        from mpi_tpu.serve.aio import make_aio_server
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.httpd import make_server
+        from mpi_tpu.serve.session import SessionManager
+
+        def start(srv):
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            return t
+
+        def stop(srv, t):
+            srv.shutdown()
+            srv.server_close()
+            t.join(timeout=10)
+
+        def call(srv, method, path, body=None, headers=None, reps=1):
+            host, port = srv.server_address[:2]
+            c = http.client.HTTPConnection(host, port, timeout=120)
+            best, nbytes, raw = float("inf"), 0, b""
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                c.request(method, path,
+                          body=json.dumps(body).encode()
+                          if body is not None else None,
+                          headers=headers or {})
+                resp = c.getresponse()
+                raw = resp.read()
+                best = min(best, time.perf_counter() - t0)
+                assert resp.status == 200, (resp.status, raw[:200])
+                nbytes = len(raw)
+            c.close()
+            return raw, nbytes, best
+
+        # -- A: JSON vs binary snapshot at 4096^2 (threaded front) ------
+        cache = EngineCache(max_size=4)
+        mgr = SessionManager(cache)
+        srv = make_server(port=0, manager=mgr)
+        thread = start(srv)
+        try:
+            raw, _, _ = call(srv, "POST", "/sessions",
+                             {"rows": 4096, "cols": 4096,
+                              "backend": "serial", "seed": 7})
+            sid = json.loads(raw)["id"]
+            path = f"/sessions/{sid}/snapshot"
+            js_raw, js_bytes, js_s = call(srv, "GET", path, reps=3)
+            bin_raw, bin_bytes, bin_s = call(
+                srv, "GET", path, reps=3,
+                headers={"Accept": wire.GRID_MEDIA_TYPE})
+            import numpy as np
+
+            grid, meta = wire.decode_frame(bin_raw)
+            js_grid = np.vstack([
+                np.frombuffer(row.encode(), dtype=np.uint8)
+                for row in json.loads(js_raw)["grid"]]) - ord("0")
+            same = np.array_equal(grid, js_grid)
+            call(srv, "DELETE", f"/sessions/{sid}")
+        finally:
+            stop(srv, thread)
+        snapshot = {
+            "board": "4096x4096",
+            "json_bytes": js_bytes, "binary_bytes": bin_bytes,
+            "bytes_ratio": round(js_bytes / bin_bytes, 2),
+            "json_s": round(js_s, 4), "binary_s": round(bin_s, 4),
+            "transfer_speedup": round(js_s / bin_s, 2),
+            "decoded_equal": bool(same),
+        }
+        assert same, "binary snapshot decoded != JSON snapshot"
+        assert snapshot["bytes_ratio"] >= 3.0, \
+            f"bytes ratio {snapshot['bytes_ratio']} under the 3x gate"
+
+        # -- B: idle pollers parked as sockets (aio front) --------------
+        workers = 4
+        n_pollers = 200
+        mgr = SessionManager(cache)
+        srv = make_aio_server(port=0, manager=mgr, workers=workers)
+        thread = start(srv)
+        host, port = srv.server_address[:2]
+        socks = []
+        try:
+            raw, _, _ = call(srv, "POST", "/sessions",
+                             {"rows": 64, "cols": 64, "backend": "tpu",
+                              "seed": 1})
+            sid = json.loads(raw)["id"]
+            call(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+            session = mgr.get(sid)
+            session.lock.acquire()      # the ticket stays pending
+            try:
+                raw, _, _ = call(srv, "POST", f"/sessions/{sid}/step",
+                                 {"steps": 1, "async": True})
+                tid = json.loads(raw)["ticket"]
+                req = (f"GET /result/{tid}?wait=1 HTTP/1.1\r\n"
+                       f"Host: x\r\n\r\n").encode()
+                for _ in range(n_pollers):
+                    s = socketlib.create_connection((host, port),
+                                                    timeout=60)
+                    s.sendall(req)
+                    socks.append(s)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if srv.stats()["parked_waiters"] >= n_pollers:
+                        break
+                    time.sleep(0.02)
+                parked = srv.stats()["parked_waiters"]
+            finally:
+                session.lock.release()
+            # every poller gets its answer when the ticket resolves
+            answered = 0
+            for s in socks:
+                if b"200" in s.recv(4096):
+                    answered += 1
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            stop(srv, thread)
+        threads_owned = 1 + workers     # event loop + worker pool
+        pollers = {
+            "idle_pollers": n_pollers, "parked_waiters": parked,
+            "answered": answered, "threads_owned": threads_owned,
+            "pollers_per_thread": round(parked / threads_owned, 1),
+        }
+        assert parked >= n_pollers, f"only {parked} waiters parked"
+        assert answered == n_pollers, \
+            f"{answered}/{n_pollers} pollers answered after resolve"
+        assert pollers["pollers_per_thread"] >= 10.0, \
+            "under the 10x pollers-per-owned-thread gate"
+
+        # -- C: blocking step throughput, threaded vs aio ---------------
+        # same compiled 64x64 tpu stepper (shared cache); min-of-3
+        # rounds of 30 sequential steps over one keep-alive connection
+        def front_gens_per_s(make):
+            mgr = SessionManager(cache)
+            srv = make(mgr)
+            t = start(srv)
+            try:
+                raw, _, _ = call(srv, "POST", "/sessions",
+                                 {"rows": 64, "cols": 64,
+                                  "backend": "tpu", "seed": 2})
+                sid = json.loads(raw)["id"]
+                call(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+                host, port = srv.server_address[:2]
+                best = float("inf")
+                for _ in range(3):
+                    c = http.client.HTTPConnection(host, port,
+                                                   timeout=120)
+                    t0 = time.perf_counter()
+                    for _ in range(30):
+                        c.request("POST", f"/sessions/{sid}/step",
+                                  body=b'{"steps": 1}')
+                        c.getresponse().read()
+                    best = min(best, time.perf_counter() - t0)
+                    c.close()
+                return 30 / best
+            finally:
+                stop(srv, t)
+
+        thr = front_gens_per_s(lambda m: make_server(port=0, manager=m))
+        aio = front_gens_per_s(
+            lambda m: make_aio_server(port=0, manager=m, workers=workers))
+        throughput = {
+            "threaded_gens_per_s": round(thr, 2),
+            "aio_gens_per_s": round(aio, 2),
+            "aio_delta_pct": round((aio - thr) / thr * 100, 2),
+        }
+        assert aio >= thr * 0.95, \
+            f"aio throughput {throughput['aio_delta_pct']}% off threaded"
+
+        out.update(ok=True, snapshot=snapshot, pollers=pollers,
+                   throughput=throughput)
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 def sparse_bench() -> None:
     """`python bench.py --sparse`: the activity-gating A/B (ISSUE 6).
 
@@ -1326,6 +1530,8 @@ if __name__ == "__main__":
         serve_bench_recovery()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve-obs":
         serve_bench_obs()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-wire":
+        serve_bench_wire()
     elif len(sys.argv) > 1 and sys.argv[1] == "--sparse":
         sparse_bench()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child":

@@ -79,6 +79,20 @@ class Obs:
         self.http_requests = m.counter(
             "mpi_tpu_http_requests_total",
             "HTTP requests by method and status code")
+        self.http_bytes_in = m.counter(
+            "mpi_tpu_http_bytes_in_total",
+            "Request body bytes read, by transport front")
+        self.http_bytes_out = m.counter(
+            "mpi_tpu_http_bytes_out_total",
+            "Response body bytes written, by transport front")
+        self.wire_encode = m.histogram(
+            "mpi_tpu_wire_encode_seconds",
+            "Grid payload encode wall (format=json|binary) per transport",
+            IO_BUCKETS)
+        self.wire_decode = m.histogram(
+            "mpi_tpu_wire_decode_seconds",
+            "Grid payload decode wall (format=json|binary) per transport",
+            IO_BUCKETS)
         self.engine_failures = m.counter(
             "mpi_tpu_engine_failures_observed_total",
             "Engine dispatch failures seen by the step path")
@@ -95,6 +109,10 @@ class Obs:
         self.dispatch_host = self.dispatch_latency.series(mode="host")
         self.occupancy_series = self.batch_occupancy.series()
         self.lock_wait_series = self.lock_wait.series()
+        for fmt in ("json", "binary"):
+            for front in ("threaded", "aio"):
+                self.wire_encode.series(format=fmt, transport=front)
+                self.wire_decode.series(format=fmt, transport=front)
 
     # -- trace delegates -------------------------------------------------
 

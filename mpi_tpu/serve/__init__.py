@@ -36,6 +36,16 @@ optional :class:`~mpi_tpu.obs.Obs` handle (``SessionManager(obs=...)``):
 request-id-tagged trace spans, Prometheus-text ``GET /metrics``, and
 ``POST /debug/profile`` device captures — all off (and off the hot
 path) when the handle is None.
+
+The serving edge (PR 7) splits transport from semantics: request
+routing/validation/error mapping live in a front-end-agnostic
+:class:`~mpi_tpu.serve.transport.AppCore`; ``serve/wire.py`` defines the
+binary grid frame both checkpoint records and the HTTP fronts share
+(negotiated via ``application/x-gol-grid``); and two fronts drive the
+core — the default byte-compatible threaded JSON server
+(``serve/httpd.py``) and a selectors event loop (``serve/aio.py``,
+``--front aio``) that parks idle ticket waiters as sockets and pushes
+chunked binary frames on ``GET /stream/<sid>``.
 """
 
 from mpi_tpu.serve.batch import MicroBatcher
@@ -50,10 +60,13 @@ from mpi_tpu.serve.session import (
 )
 from mpi_tpu.serve.ticket import AsyncDispatcher, Ticket, TicketQueueFullError
 from mpi_tpu.serve.httpd import make_server
+from mpi_tpu.serve.transport import AppCore
+from mpi_tpu.serve.wire import WireError, decode_frame, encode_frame
 
 __all__ = [
     "EngineCache", "MicroBatcher", "SessionManager", "make_server",
     "StateStore", "FaultInjector", "FaultPlan", "InjectedFault",
     "DeadlineError", "EngineStepError", "EngineUnavailableError",
     "AsyncDispatcher", "Ticket", "TicketQueueFullError",
+    "AppCore", "WireError", "encode_frame", "decode_frame",
 ]

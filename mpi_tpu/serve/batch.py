@@ -291,6 +291,7 @@ class MicroBatcher:
                 manager._checkpoint(s)  # session lock is held (leader)
             finally:
                 reset_request_id(token)
+            manager._notify_step(s)
             e.result = {"id": s.id, "generation": s.generation,
                         "steps": steps, "batched": B}
         manager._mark_dispatch_ok()

@@ -107,6 +107,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="arm POST /debug/profile?secs=N: captures a "
                    "jax.profiler device trace into DIR (off when unset)")
+    p.add_argument("--front", choices=("threaded", "aio"),
+                   default="threaded",
+                   help="HTTP front end: 'threaded' (stdlib thread-per-"
+                   "connection, the default — byte-compatible JSON) or "
+                   "'aio' (selectors event loop: idle ticket waiters park "
+                   "as sockets, GET /stream/<sid> pushes binary frames)")
+    p.add_argument("--http-max-body", type=int, default=64 << 20,
+                   metavar="BYTES",
+                   help="reject request bodies larger than this with a "
+                   "structured 413 before reading (default 64 MiB)")
+    p.add_argument("--aio-workers", type=int, default=4,
+                   help="worker threads the aio front uses for blocking "
+                   "session verbs (the event loop itself never blocks)")
+    p.add_argument("--stream-buffer-kib", type=int, default=256,
+                   help="per-socket write-buffer bound for /stream "
+                   "consumers; a slower consumer gets drop-to-latest "
+                   "frames instead of an unbounded queue")
     return p
 
 
@@ -149,8 +166,19 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    server = make_server(args.host, args.port, manager, verbose=args.verbose,
-                         profile_dir=args.profile_dir)
+    if args.front == "aio":
+        from mpi_tpu.serve.aio import make_aio_server
+
+        server = make_aio_server(
+            args.host, args.port, manager, verbose=args.verbose,
+            profile_dir=args.profile_dir, max_body=args.http_max_body,
+            workers=args.aio_workers,
+            stream_buffer=args.stream_buffer_kib << 10)
+    else:
+        server = make_server(args.host, args.port, manager,
+                             verbose=args.verbose,
+                             profile_dir=args.profile_dir,
+                             max_body=args.http_max_body)
     host, port = server.server_address[:2]
     batch = ("off" if args.no_batch else
              f"window {args.batch_window_ms}ms max {args.batch_max}")
@@ -169,6 +197,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         extras.append(f"trace-log {args.trace_log}")
     if args.profile_dir:
         extras.append(f"profile-dir {args.profile_dir}")
+    if args.front != "threaded":
+        extras.append(f"front {args.front} ({args.aio_workers} workers)")
     extra = (", " + ", ".join(extras)) if extras else ""
     print(f"[mpi_tpu] serving on http://{host}:{port} "
           f"(cache size {args.cache_size}, batch {batch}{extra})", flush=True)

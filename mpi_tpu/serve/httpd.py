@@ -1,56 +1,21 @@
-"""Stdlib-only HTTP front end (``http.server`` + JSON, no new deps).
+"""Threaded stdlib HTTP front end (``http.server``, the default).
 
-Routes (all bodies and responses are JSON):
+PR 7 moved the request semantics — routing, validation, manager verbs,
+error mapping, wire-format negotiation, observability — into
+:class:`~mpi_tpu.serve.transport.AppCore`; this module is the thin
+transport that remains: one ``BaseHTTPRequestHandler`` that packages
+each request into a :class:`~mpi_tpu.serve.transport.Request`, calls
+``core.dispatch``, and writes the :class:`Response` back.  The bytes on
+the wire for every JSON route are unchanged from PR 6 (same payload
+construction, same ``json.dumps``, same header sequence) — gated by
+``tools/obs_smoke.py``.
 
-    POST   /sessions                   create a board (spec in body)
-    POST   /sessions/<id>/step         advance; body {"steps": k}, default 1.
-                                       {"async": true} in the body (or
-                                       ?async=1) enqueues instead and
-                                       answers {"ticket": ..., "status":
-                                       "pending"} immediately
-    GET    /result/<ticket>            the ticket's outcome: pending, done
-                                       (with the step result), or the SAME
-                                       structured 503/404 the blocking path
-                                       would have answered; ?wait=1 blocks
-                                       until resolution (request budget)
-    GET    /sessions/<id>/snapshot     full grid as '0'/'1' row strings
-    GET    /sessions/<id>/density      live-cell count / density
-    DELETE /sessions/<id>              close the board
-    GET    /healthz                    liveness probe
-    GET    /stats                      cache counters + per-session throughput
-                                       + microbatch occupancy/amortization
-                                       (the ``batch`` section, when enabled)
-    GET    /metrics                    Prometheus text exposition (the one
-                                       non-JSON route; 404 when the manager
-                                       runs with obs disabled)
-    POST   /debug/profile?secs=N       capture a jax.profiler device trace
-                                       over live traffic (requires
-                                       --profile-dir; one capture at a time)
-
-Observability (PR 4): every request's id is entered into the obs
-request-id contextvar for its whole handling, so spans recorded anywhere
-downstream — session lock waits, batched dispatches on the leader's
-thread, checkpoint writes, watchdog workers — carry the same id as the
-``http_request`` span and the access-log line.  The catch-all 500
-additionally dumps the trace ring to disk (or points at the live
-``--trace-log``) so the evidence for a crash report survives the
-process.
-
-Errors: 400 with {"error": ...} for bad specs/bodies (``ConfigError``/
-``ValueError``), 404 for unknown sessions and routes, 503 for fault-
-tolerance outcomes (deadline exceeded, breaker open with degradation
-disabled, retries exhausted — the session survives all three), and a
-catch-all 500 with ``{"error": ..., "request_id": N}`` for anything
-unexpected: a bug must answer structured JSON on a live connection,
-never ``http.server``'s stock HTML traceback page.  Every request gets
-a server-unique id; verbose mode logs it with the outcome line and the
-500 path prints the traceback to stderr under the same id, so a client
-report ("request 1041 gave me a 500") lines up with the server log.
-
-Per-request deadline override: ``?timeout_s=SECONDS`` on any session
-verb (or a ``timeout_s`` body key on step/create) overrides the
-server-wide ``--request-timeout-s``; ``timeout_s=0`` disables the
-budget for that request.
+Routes, error shapes, deadline overrides, and the binary grid protocol
+are documented on :mod:`mpi_tpu.serve.transport` (one doc, N fronts).
+The one route this front cannot serve is ``GET /stream/<sid>`` — a
+blocking thread per open-ended stream is exactly the thread-per-idle-
+client model the selectors front (``serve/aio.py``, ``--front aio``)
+exists to replace — so the core answers it a structured 501 here.
 
 The server is a ``ThreadingHTTPServer`` — requests against different
 boards run concurrently; the per-session locks in ``session.py``
@@ -61,24 +26,15 @@ dispatches by ``serve/batch.py``.
 
 from __future__ import annotations
 
-import itertools
-import json
-import sys
-import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
-from urllib.parse import parse_qs, urlsplit
+from typing import Optional
 
-from mpi_tpu.config import ConfigError
-from mpi_tpu.obs.trace import reset_request_id, set_request_id
-from mpi_tpu.serve.session import (
-    DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
-    TicketQueueFullError,
-)
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.serve.transport import AppCore, DEFAULT_MAX_BODY, Request
 
 
 class _Handler(BaseHTTPRequestHandler):
-    # the manager is attached to the *server* by make_server; handlers are
+    # the core is attached to the *server* by make_server; handlers are
     # constructed per request
     protocol_version = "HTTP/1.1"
 
@@ -86,215 +42,43 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self.server, "verbose", False):
             super().log_message(fmt, *args)
 
-    # -- plumbing ----------------------------------------------------------
-
-    def _reply(self, code: int, payload: dict) -> None:
-        self._reply_bytes(code, json.dumps(payload).encode(),
-                          "application/json")
-
-    def _reply_text(self, code: int, text: str, content_type: str) -> None:
-        self._reply_bytes(code, text.encode("utf-8"), content_type)
-
-    def _reply_bytes(self, code: int, body: bytes,
-                     content_type: str) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
+    def _run(self, method: str) -> None:
+        core: AppCore = self.server.core
+        req = Request(method, self.path, self.headers, self.rfile.read)
+        resp = core.dispatch(req, transport="threaded")
+        self.send_response(resp.code)
+        self.send_header("Content-Type", resp.content_type)
+        self.send_header("Content-Length", str(len(resp.body)))
+        for name, value in resp.headers:
+            self.send_header(name, value)
+        if resp.close:
+            # an unread request body (the 413 path) poisons keep-alive
+            # framing: tell the client and drop the connection
+            self.send_header("Connection", "close")
+            self.close_connection = True
         self.end_headers()
-        self.wfile.write(body)
-        self._last_code = code          # the http_request span's code tag
-        if getattr(self.server, "verbose", False):
-            print(f"[mpi_tpu] request {getattr(self, '_rid', '?')}: "
-                  f"{self.command} {self.path} -> {code}", file=sys.stderr)
-
-    def _body(self) -> dict:
-        n = int(self.headers.get("Content-Length") or 0)
-        if n == 0:
-            return {}
-        try:
-            data = json.loads(self.rfile.read(n) or b"{}")
-        except json.JSONDecodeError as e:
-            raise ConfigError(f"request body is not valid JSON: {e}")
-        if not isinstance(data, dict):
-            raise ConfigError("request body must be a JSON object")
-        return data
-
-    def _timeout_override(self, body: dict) -> Optional[float]:
-        """The request's explicit deadline override, or None to use the
-        server default: ``?timeout_s=`` wins over a ``timeout_s`` body
-        key.  (It is a transport parameter, not part of the board spec —
-        the create body's strict key check never sees it.)"""
-        qs = parse_qs(urlsplit(self.path).query)
-        raw = qs["timeout_s"][0] if "timeout_s" in qs else body.pop(
-            "timeout_s", None)
-        if raw is None:
-            return None
-        try:
-            return float(raw)
-        except (TypeError, ValueError):
-            raise ConfigError(f"timeout_s must be a number, got {raw!r}")
-
-    def _query_flag(self, name: str) -> bool:
-        """A boolean query parameter (``?async=1``, ``?wait=true``)."""
-        qs = parse_qs(urlsplit(self.path).query)
-        return (qs.get(name, ["0"])[0].lower() in ("1", "true", "yes"))
-
-    def _route(self) -> Tuple[str, Optional[str], Optional[str]]:
-        """(kind, session_id, verb) from the path."""
-        parts = [p for p in self.path.split("?")[0].split("/") if p]
-        if parts == ["healthz"]:
-            return "healthz", None, None
-        if parts == ["stats"]:
-            return "stats", None, None
-        if parts == ["metrics"]:
-            return "metrics", None, None
-        if parts == ["debug", "profile"]:
-            return "profile", None, None
-        if len(parts) == 2 and parts[0] == "result":
-            return "result", parts[1], None     # parts[1] is the ticket id
-        if parts and parts[0] == "sessions":
-            if len(parts) == 1:
-                return "sessions", None, None
-            if len(parts) == 2:
-                return "session", parts[1], None
-            if len(parts) == 3:
-                return "session", parts[1], parts[2]
-        return "unknown", None, None
-
-    def _dispatch(self, method: str) -> None:
-        rid = next(self.server.request_ids)
-        self._rid = rid                     # _reply's verbose outcome line
-        self._last_code = 0
-        obs = getattr(self.server, "obs", None)
-        if obs is None:
-            return self._handle(method, rid, None)
-        # one shared id per request: every span recorded while this
-        # request is being handled — in this thread, in the watchdog
-        # worker (context copied), in the batch leader (entry.rid) —
-        # carries it, which is what makes the JSONL reconstructable
-        token = set_request_id(rid)
-        try:
-            with obs.span("http_request", method=method,
-                          path=self.path) as sp:
-                self._handle(method, rid, obs)
-                sp.tag(code=self._last_code)
-            obs.http_requests.inc(method=method, code=self._last_code)
-        finally:
-            reset_request_id(token)
-
-    def _handle(self, method: str, rid: int, obs) -> None:
-        mgr: SessionManager = self.server.manager
-        kind, sid, verb = self._route()
-        try:
-            if kind == "metrics" and method == "GET":
-                if obs is None:
-                    return self._reply(404, {
-                        "error": "observability is disabled (--no-obs)"})
-                return self._reply_text(
-                    200, obs.render_metrics(),
-                    "text/plain; version=0.0.4; charset=utf-8")
-            if kind == "profile" and method == "POST":
-                return self._profile()
-            if kind == "healthz" and method == "GET":
-                health = mgr.health()
-                return self._reply(200 if health["ok"] else 503, health)
-            if kind == "stats" and method == "GET":
-                return self._reply(200, mgr.stats())
-            if kind == "sessions" and method == "POST":
-                body = self._body()
-                timeout_s = self._timeout_override(body)
-                return self._reply(200, mgr.create(body, timeout_s=timeout_s))
-            if kind == "result" and method == "GET" and sid is not None:
-                return self._reply(200, mgr.ticket_result(
-                    sid, wait=self._query_flag("wait"),
-                    timeout_s=self._timeout_override({})))
-            if kind == "session" and sid is not None:
-                if method == "POST" and verb == "step":
-                    body = self._body()
-                    timeout_s = self._timeout_override(body)
-                    steps = body.get("steps", 1)
-                    if not isinstance(steps, int):
-                        raise ConfigError(f"steps must be an int, got {steps!r}")
-                    if self._query_flag("async") or bool(body.get("async")):
-                        return self._reply(200, mgr.step_async(
-                            sid, steps, timeout_s=timeout_s))
-                    return self._reply(
-                        200, mgr.step(sid, steps, timeout_s=timeout_s))
-                if method == "GET" and verb == "snapshot":
-                    return self._reply(200, mgr.snapshot(
-                        sid, timeout_s=self._timeout_override({})))
-                if method == "GET" and verb == "density":
-                    return self._reply(200, mgr.density(
-                        sid, timeout_s=self._timeout_override({})))
-                if method == "DELETE" and verb is None:
-                    return self._reply(200, mgr.close(
-                        sid, timeout_s=self._timeout_override({})))
-            return self._reply(404, {"error": f"no route {method} {self.path}"})
-        except KeyError:
-            what = "ticket" if kind == "result" else "session"
-            return self._reply(404, {"error": f"no {what} {sid!r}"})
-        except (DeadlineError, EngineUnavailableError, EngineStepError,
-                TicketQueueFullError) as e:
-            # fault-tolerance outcomes: the session survives; 503 tells
-            # the client "try again / try later", never "you sent garbage"
-            return self._reply(503, {"error": str(e), "request_id": rid})
-        except (ConfigError, ValueError) as e:
-            return self._reply(400, {"error": str(e)})
-        except Exception as e:  # noqa: BLE001 — the structured-500 backstop
-            # without this, http.server answers an HTML traceback page and
-            # drops the connection; a JSON API must fail in JSON.  The
-            # traceback goes to stderr under the request id, not the wire.
-            print(f"[mpi_tpu] request {rid}: unhandled "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-            traceback.print_exc(file=sys.stderr)
-            payload = {
-                "error": f"internal server error ({type(e).__name__})",
-                "request_id": rid,
-            }
-            if obs is not None:
-                # flush the evidence: the ring (or live --trace-log)
-                # holds the request's spans up to the failure point
-                dump = obs.tracer.dump_on_crash(
-                    f"request {rid}: {type(e).__name__}: {e}")
-                if dump:
-                    payload["trace_dump"] = dump
-                    print(f"[mpi_tpu] request {rid}: trace dumped to "
-                          f"{dump}", file=sys.stderr)
-            return self._reply(500, payload)
-
-    def _profile(self) -> None:
-        logdir = getattr(self.server, "profile_dir", None)
-        if logdir is None:
-            return self._reply(404, {
-                "error": "profiling is disabled "
-                         "(start the server with --profile-dir)"})
-        qs = parse_qs(urlsplit(self.path).query)
-        raw = qs["secs"][0] if "secs" in qs else "1"
-        try:
-            secs = float(raw)
-        except (TypeError, ValueError):
-            raise ConfigError(f"secs must be a number, got {raw!r}")
-        from mpi_tpu.obs.profile import run_profile
-
-        result = run_profile(logdir, secs)
-        return self._reply(200 if result["ok"] else 503, result)
+        self.wfile.write(resp.body)
 
     # -- verbs -------------------------------------------------------------
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        self._dispatch("GET")
+        self._run("GET")
 
     def do_POST(self):  # noqa: N802
-        self._dispatch("POST")
+        self._run("POST")
+
+    def do_PUT(self):  # noqa: N802
+        self._run("PUT")
 
     def do_DELETE(self):  # noqa: N802
-        self._dispatch("DELETE")
+        self._run("DELETE")
 
 
 def make_server(host: str = "127.0.0.1", port: int = 0,
                 manager: Optional[SessionManager] = None,
                 verbose: bool = False,
-                profile_dir: Optional[str] = None) -> ThreadingHTTPServer:
+                profile_dir: Optional[str] = None,
+                max_body: int = DEFAULT_MAX_BODY) -> ThreadingHTTPServer:
     """A ready-to-run server (not yet serving — call ``serve_forever`` or
     drive it from a thread; ``port=0`` binds an ephemeral port, which the
     tests use).  The bound address is ``server.server_address``.
@@ -302,9 +86,12 @@ def make_server(host: str = "127.0.0.1", port: int = 0,
     whether ``/metrics`` serves and spans record; ``profile_dir`` arms
     ``POST /debug/profile``."""
     server = ThreadingHTTPServer((host, port), _Handler)
-    server.manager = manager if manager is not None else SessionManager()
+    server.core = AppCore(manager, verbose=verbose, profile_dir=profile_dir,
+                          max_body=max_body)
+    # kept as server attributes too — tests and tools reach for these
+    server.manager = server.core.manager
     server.verbose = verbose
-    server.request_ids = itertools.count(1)
-    server.obs = server.manager.obs
+    server.request_ids = server.core.request_ids
+    server.obs = server.core.obs
     server.profile_dir = profile_dir
     return server

@@ -47,26 +47,29 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from mpi_tpu.serve import wire
+
 RECORD_VERSION = 1
 
 
 def encode_grid(grid: np.ndarray) -> dict:
-    """A JSON-safe packed snapshot of a 0/1 uint8 grid."""
+    """A JSON-safe packed snapshot of a 0/1 uint8 grid — a base64
+    wrapper over the one packbits core (``serve/wire.py``), so records
+    and binary wire frames can never pack differently.  The bytes are
+    unchanged from PR 3: existing ``--state-dir`` records decode
+    bit-identically (pinned by ``tests/test_wire.py``)."""
     arr = np.asarray(grid, dtype=np.uint8)
     rows, cols = arr.shape
-    packed = np.packbits(arr, axis=None)
     return {
         "rows": int(rows),
         "cols": int(cols),
-        "packed": base64.b64encode(packed.tobytes()).decode("ascii"),
+        "packed": base64.b64encode(wire.pack_grid(arr)).decode("ascii"),
     }
 
 
 def decode_grid(snap: dict) -> np.ndarray:
     rows, cols = int(snap["rows"]), int(snap["cols"])
-    raw = np.frombuffer(base64.b64decode(snap["packed"]), dtype=np.uint8)
-    bits = np.unpackbits(raw, count=rows * cols)
-    return bits.reshape(rows, cols).astype(np.uint8)
+    return wire.unpack_grid(base64.b64decode(snap["packed"]), rows, cols)
 
 
 class StateStore:

@@ -155,6 +155,34 @@ def _parse_spec(spec: dict):
     return config, segments
 
 
+def format_grid_rows(grid) -> list:
+    """The JSON snapshot's grid encoding — one '0'/'1' string per row.
+    Shared with the transport layer (``serve/transport.py``) so the
+    JSON and binary wire paths format from the same fetched array and
+    can never drift."""
+    return ["".join("1" if v else "0" for v in row)
+            for row in np.asarray(grid, dtype=np.uint8)]
+
+
+def parse_grid_rows(rows) -> np.ndarray:
+    """Inverse of :func:`format_grid_rows` for board writes: a list of
+    '0'/'1' strings (or of 0/1 int lists) to a uint8 array.  Ragged or
+    non-binary input is a :class:`ConfigError` (HTTP 400)."""
+    if not isinstance(rows, list) or not rows:
+        raise ConfigError("grid must be a non-empty list of rows")
+    try:
+        arr = np.array([[int(c) for c in row] for row in rows],
+                       dtype=np.uint8)
+    except (TypeError, ValueError) as e:
+        raise ConfigError(f"grid rows must be '0'/'1' strings or 0/1 "
+                          f"lists: {e}")
+    if arr.ndim != 2:
+        raise ConfigError("grid rows must all have the same length")
+    if arr.max(initial=0) > 1:
+        raise ConfigError("grid cells must be 0 or 1")
+    return arr
+
+
 def _normalize_timeout(timeout_s: Optional[float]) -> Optional[float]:
     """The one timeout convention, in one place: ``None`` means "no
     explicit value" and any ``<= 0`` means "disable the budget" — both
@@ -312,6 +340,11 @@ class SessionManager:
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._next = 0
+        # step listeners (the aio front's stream hub): called after every
+        # committed step/board-write, often with the session lock held —
+        # a listener must only flip flags and wake a poller, never block
+        self._step_listeners: list = []
+        self._listeners_lock = threading.Lock()
         # fault tolerance
         self.request_timeout_s = _normalize_timeout(request_timeout_s)
         if step_retries < 0:
@@ -468,6 +501,32 @@ class SessionManager:
         if session is None:
             raise KeyError(sid)
         return session
+
+    # -- step listeners ----------------------------------------------------
+
+    def add_step_listener(self, fn) -> None:
+        """Register ``fn(session)`` to run after every committed step or
+        board write (all commit paths: solo, microbatch, async ticket).
+        Called with the session lock frequently held — the callback must
+        be non-blocking (set a flag, wake a selector)."""
+        with self._listeners_lock:
+            self._step_listeners.append(fn)
+
+    def remove_step_listener(self, fn) -> None:
+        with self._listeners_lock:
+            try:
+                self._step_listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify_step(self, session: Session) -> None:
+        with self._listeners_lock:
+            listeners = tuple(self._step_listeners)
+        for fn in listeners:
+            try:
+                fn(session)
+            except Exception:  # noqa: BLE001 — a viewer must not fail a step
+                pass
 
     # -- checkpoint / restore ---------------------------------------------
 
@@ -832,6 +891,7 @@ class SessionManager:
                 obs.dispatch_host.observe(t1 - t0)
         session.generation += steps
         self._checkpoint(session)
+        self._notify_step(session)
         return {"id": session.id, "generation": session.generation,
                 "steps": steps}
 
@@ -896,7 +956,16 @@ class SessionManager:
         return _watchdog_call(lambda: self._snapshot(sid), deadline,
                               f"snapshot({sid})")
 
-    def _snapshot(self, sid: str) -> dict:
+    def snapshot_array(self, sid: str, timeout_s: Optional[float] = None):
+        """``(grid_np, generation, config)`` under the same lock/deadline
+        discipline as :meth:`snapshot` — the transport layer's fetch for
+        both wire formats (it formats JSON rows or a binary frame from
+        the same array, so the two paths cannot disagree)."""
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(lambda: self._snapshot_grid(sid), deadline,
+                              f"snapshot({sid})")
+
+    def _snapshot_grid(self, sid: str):
         session = self.get(sid)
         with session.lock:
             if session.closed:
@@ -912,11 +981,60 @@ class SessionManager:
                         "snapshot over HTTP needs single-host execution")
             else:
                 grid = session.grid
-        rows = ["".join("1" if v else "0" for v in row) for row in
-                np.asarray(grid, dtype=np.uint8)]
+        return np.asarray(grid, dtype=np.uint8), generation, session.config
+
+    def _snapshot(self, sid: str) -> dict:
+        grid, generation, config = self._snapshot_grid(sid)
         return {"id": sid, "generation": generation,
-                "rows": session.config.rows, "cols": session.config.cols,
-                "grid": rows}
+                "rows": config.rows, "cols": config.cols,
+                "grid": format_grid_rows(grid)}
+
+    def write_board(self, sid: str, grid, generation: Optional[int] = None,
+                    timeout_s: Optional[float] = None) -> dict:
+        """Overwrite a live board's grid (the board-write endpoint).
+        ``generation=None`` keeps the session's current generation;
+        an explicit value rebases it (a client uploading a saved world).
+        The written grid is persisted as a snapshot checkpoint
+        immediately: replay-from-seed is no longer valid once a board
+        has been written to, so durability must anchor on the write."""
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(lambda: self._write_board(sid, grid, generation),
+                              deadline, f"write_board({sid})")
+
+    def _write_board(self, sid: str, grid,
+                     generation: Optional[int]) -> dict:
+        session = self.get(sid)
+        arr = np.ascontiguousarray(grid, dtype=np.uint8)
+        shape = (session.config.rows, session.config.cols)
+        if arr.shape != shape:
+            raise ConfigError(
+                f"grid shape {arr.shape} does not match session "
+                f"{shape[0]}x{shape[1]}")
+        if arr.max(initial=0) > 1:
+            raise ConfigError("grid cells must be 0 or 1")
+        with session.lock:
+            if session.closed:
+                raise KeyError(sid)
+            if session.engine is not None:
+                # same entry point the restore path uses: the engine
+                # re-stages the array (and resets any sparse dirty map)
+                session.grid = session.engine.init_grid(
+                    initial=arr, seed=session.config.seed)
+            else:
+                session.grid = arr
+            if generation is not None:
+                if generation < 0:
+                    raise ConfigError(
+                        f"generation must be >= 0, got {generation}")
+                session.generation = int(generation)
+            self._persist(session, grid_np=arr)
+            out = {"id": sid, "generation": session.generation,
+                   "rows": shape[0], "cols": shape[1], "written": True}
+        if self.obs is not None:
+            self.obs.event("board_write", sid=sid,
+                           generation=out["generation"])
+        self._notify_step(session)
+        return out
 
     def density(self, sid: str, timeout_s: Optional[float] = None) -> dict:
         deadline = _Deadline(self._budget(timeout_s))

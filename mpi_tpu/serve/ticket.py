@@ -73,7 +73,7 @@ class Ticket:
 
     __slots__ = ("id", "sid", "steps", "remaining", "deadline", "status",
                  "result", "error", "event", "rid", "enqueued_mono",
-                 "done_mono", "unit_rounds", "max_batched")
+                 "done_mono", "unit_rounds", "max_batched", "callbacks")
 
     def __init__(self, tid: str, sid: str, steps: int, deadline):
         self.id = tid
@@ -90,6 +90,7 @@ class Ticket:
         self.done_mono: Optional[float] = None
         self.unit_rounds = 0            # device rounds this ticket rode in
         self.max_batched = 0            # widest batch it shared (0 = solo)
+        self.callbacks: List = []       # resolution callbacks (aio waiters)
 
 
 class AsyncDispatcher:
@@ -252,7 +253,44 @@ class AsyncDispatcher:
                 self._completed_by_sid.get(ticket.sid, 0) + 1)
             self._done_order.append((ticket.id, ticket.done_mono))
             self._evict_locked()
+            callbacks, ticket.callbacks = ticket.callbacks, []
         ticket.event.set()
+        # resolution callbacks fire AFTER the event, outside _cv, possibly
+        # with session locks held (the group commit loop) — a callback
+        # must only flip flags and wake a selector, never block.  This is
+        # how the aio front wakes exactly the sockets parked on this
+        # ticket instead of burning a thread per waiter.
+        for fn in callbacks:
+            try:
+                fn(ticket)
+            except Exception:  # noqa: BLE001 — a waiter must not fail commit
+                pass
+
+    def on_resolve(self, tid: str, fn) -> bool:
+        """Register ``fn(ticket)`` to run when ``tid`` resolves.  If the
+        ticket is already resolved, ``fn`` runs synchronously here and
+        False is returned (nothing was parked); True means parked.
+        Unknown tickets raise ``KeyError`` (the 404-after-restart
+        contract).  Same non-blocking rules as above."""
+        with self._cv:
+            ticket = self._tickets.get(tid)
+            if ticket is None:
+                raise KeyError(tid)
+            if ticket.status == "pending":
+                ticket.callbacks.append(fn)
+                return True
+        fn(ticket)
+        return False
+
+    def cancel_resolve(self, tid: str, fn) -> None:
+        """Best-effort unpark (a waiter's wait budget expired first)."""
+        with self._cv:
+            ticket = self._tickets.get(tid)
+            if ticket is not None:
+                try:
+                    ticket.callbacks.remove(fn)
+                except ValueError:
+                    pass
 
     def _evict_locked(self) -> None:
         """Age out the oldest RESOLVED tickets: anything beyond the
@@ -473,6 +511,7 @@ class AsyncDispatcher:
                     manager._checkpoint(s)
                 finally:
                     reset_request_id(token)
+                manager._notify_step(s)
                 t.remaining = 0
                 t.unit_rounds += adv
                 t.max_batched = max(t.max_batched, B if B > 1 else 0)

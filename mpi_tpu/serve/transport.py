@@ -1,0 +1,463 @@
+"""Front-end-agnostic HTTP application core for the serve stack.
+
+PR 7 splits ``serve/httpd.py`` in two: the request *semantics* — routing,
+validation, session-manager verbs, error→status mapping, observability,
+wire-format negotiation — live here in :class:`AppCore`, while the
+*transports* own sockets and bytes: the threaded stdlib front
+(``serve/httpd.py``, the default — byte-compatible with the PR-6
+responses) and the selectors-based non-blocking front (``serve/aio.py``)
+both feed :meth:`AppCore.dispatch` a :class:`Request` and write back the
+:class:`Response` it returns.  One core, N fronts — the two can never
+drift on a route or an error shape.
+
+Wire-format negotiation (the binary protocol rides here so every front
+gets it for free):
+
+* ``GET /sessions/<id>/snapshot`` — ``Accept: application/x-gol-grid``
+  answers one binary frame (``serve/wire.py``); anything else answers
+  the PR-1 JSON shape, byte-identical.  Both come from the same
+  ``SessionManager.snapshot_array`` fetch, so the formats cannot
+  disagree about the grid.
+* ``PUT /sessions/<id>/board`` — board write.  ``Content-Type:
+  application/x-gol-grid`` sends a binary frame (its header's
+  generation field, when flagged, rebases the session's generation);
+  JSON sends ``{"grid": ['0101', ...], "generation": optional}``.
+* ``GET /result/<ticket>`` — with binary ``Accept``: a *done* ticket
+  answers a frame of the session's current grid; pending/error answer
+  the usual JSON (status codes carry the semantics either way).
+* ``GET /stream/<sid>?every=k`` — returns a :class:`StreamPlan`; only
+  the aio front can park a socket and push frames, so the core answers
+  a structured 501 on any other transport.
+
+Request bodies are bounded (``--http-max-body``): a ``Content-Length``
+over the bound answers a structured 413 *before any body byte is read*,
+and the connection is closed (the unread body makes keep-alive framing
+unrecoverable).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+import time
+import traceback
+from typing import Callable, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from mpi_tpu.config import ConfigError
+from mpi_tpu.obs.trace import reset_request_id, set_request_id
+from mpi_tpu.serve import wire
+from mpi_tpu.serve.session import (
+    DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
+    TicketQueueFullError, format_grid_rows, parse_grid_rows,
+)
+
+DEFAULT_MAX_BODY = 64 << 20             # 64 MiB
+
+
+class Request:
+    """What a front hands the core: parsed request line + headers plus a
+    lazy body reader (``read(n)``) — the core decides whether the body
+    is ever read (the 413 path never reads it)."""
+
+    __slots__ = ("method", "path", "headers", "read")
+
+    def __init__(self, method: str, path: str, headers,
+                 read: Callable[[int], bytes]):
+        self.method = method
+        self.path = path
+        self.headers = headers          # any mapping with .get(name)
+        self.read = read
+
+
+class Response:
+    """What the core hands back: status + body + content type, plus any
+    extra headers and whether the connection must close after the write
+    (the 413 path — an unread body poisons keep-alive framing)."""
+
+    __slots__ = ("code", "body", "content_type", "headers", "close")
+
+    def __init__(self, code: int, body: bytes, content_type: str,
+                 headers: Optional[List[Tuple[str, str]]] = None,
+                 close: bool = False):
+        self.code = code
+        self.body = body
+        self.content_type = content_type
+        self.headers = headers or []
+        self.close = close
+
+
+class StreamPlan:
+    """A negotiated ``GET /stream/<sid>?every=k``: the aio front turns
+    this into a chunked-transfer push stream of binary frames.  Fronts
+    that cannot stream never see one — the core answers 501 for them."""
+
+    __slots__ = ("sid", "every", "code")
+
+    def __init__(self, sid: str, every: int):
+        self.sid = sid
+        self.every = int(every)
+        self.code = 200
+
+
+def json_response(code: int, payload: dict, close: bool = False) -> Response:
+    # the one JSON encoder both fronts share — byte-identical to the
+    # PR-6 handler's json.dumps(payload).encode()
+    return Response(code, json.dumps(payload).encode(),
+                    "application/json", close=close)
+
+
+class AppCore:
+    """The transport-agnostic request handler.
+
+    A front constructs one core at server build time and calls
+    :meth:`dispatch` per request from whatever thread (or event loop
+    callback) it likes — the core is stateless between requests apart
+    from the shared request-id counter, and every manager verb it calls
+    is already thread-safe.
+    """
+
+    def __init__(self, manager: Optional[SessionManager] = None,
+                 verbose: bool = False,
+                 profile_dir: Optional[str] = None,
+                 max_body: int = DEFAULT_MAX_BODY):
+        self.manager = manager if manager is not None else SessionManager()
+        self.verbose = verbose
+        self.profile_dir = profile_dir
+        if max_body < 1:
+            raise ValueError(f"max_body must be >= 1, got {max_body}")
+        self.max_body = int(max_body)
+        self.request_ids = itertools.count(1)
+        self.obs = self.manager.obs
+
+    # -- byte accounting (fronts call count_out for stream pushes too) -----
+
+    def count_in(self, n: int, transport: str) -> None:
+        if self.obs is not None and n:
+            self.obs.http_bytes_in.inc(n, transport=transport)
+
+    def count_out(self, n: int, transport: str) -> None:
+        if self.obs is not None and n:
+            self.obs.http_bytes_out.inc(n, transport=transport)
+
+    # -- entry point -------------------------------------------------------
+
+    def dispatch(self, req: Request, transport: str):
+        """Handle one request; returns a :class:`Response` (or a
+        :class:`StreamPlan` when ``transport == "aio"`` negotiated a
+        stream).  Never raises — every failure maps to a structured
+        JSON status, same discipline as the PR-3 handler."""
+        rid = next(self.request_ids)
+        obs = self.obs
+        if obs is None:
+            resp = self._guard(req, rid, None, transport)
+        else:
+            # one shared id per request: every span recorded while this
+            # request is handled — here, in the watchdog worker, in the
+            # batch leader — carries it (JSONL reconstructability)
+            token = set_request_id(rid)
+            try:
+                with obs.span("http_request", method=req.method,
+                              path=req.path) as sp:
+                    resp = self._guard(req, rid, obs, transport)
+                    sp.tag(code=resp.code)
+                obs.http_requests.inc(method=req.method, code=resp.code)
+            finally:
+                reset_request_id(token)
+        if not isinstance(resp, StreamPlan):
+            self.count_out(len(resp.body), transport)
+        if self.verbose:
+            print(f"[mpi_tpu] request {rid}: {req.method} {req.path} -> "
+                  f"{resp.code}", file=sys.stderr)
+        return resp
+
+    # -- request plumbing --------------------------------------------------
+
+    def _content_length(self, req: Request) -> int:
+        raw = req.headers.get("Content-Length")
+        if not raw:
+            return 0
+        try:
+            n = int(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(f"Content-Length must be an integer, "
+                              f"got {raw!r}")
+        if n < 0:
+            raise ConfigError(f"Content-Length must be >= 0, got {n}")
+        return n
+
+    def _raw_body(self, req: Request, transport: str) -> bytes:
+        n = self._content_length(req)
+        if n == 0:
+            return b""
+        data = req.read(n)
+        self.count_in(len(data), transport)
+        return data
+
+    def _body(self, req: Request, transport: str) -> dict:
+        raw = self._raw_body(req, transport)
+        if not raw:
+            return {}
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ConfigError(f"request body is not valid JSON: {e}")
+        if not isinstance(data, dict):
+            raise ConfigError("request body must be a JSON object")
+        return data
+
+    def _timeout_override(self, req: Request, body: dict) -> Optional[float]:
+        """The request's explicit deadline override, or None to use the
+        server default: ``?timeout_s=`` wins over a ``timeout_s`` body
+        key.  (It is a transport parameter, not part of the board spec —
+        the create body's strict key check never sees it.)"""
+        qs = parse_qs(urlsplit(req.path).query)
+        raw = qs["timeout_s"][0] if "timeout_s" in qs else body.pop(
+            "timeout_s", None)
+        if raw is None:
+            return None
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(f"timeout_s must be a number, got {raw!r}")
+
+    def _query_flag(self, req: Request, name: str) -> bool:
+        """A boolean query parameter (``?async=1``, ``?wait=true``)."""
+        qs = parse_qs(urlsplit(req.path).query)
+        return (qs.get(name, ["0"])[0].lower() in ("1", "true", "yes"))
+
+    def _wants_binary(self, req: Request) -> bool:
+        return wire.GRID_MEDIA_TYPE in (req.headers.get("Accept") or "")
+
+    def _sends_binary(self, req: Request) -> bool:
+        ct = (req.headers.get("Content-Type") or "").split(";")[0].strip()
+        return ct == wire.GRID_MEDIA_TYPE
+
+    def _route(self, req: Request):
+        """(kind, session_id, verb) from the path."""
+        parts = [p for p in req.path.split("?")[0].split("/") if p]
+        if parts == ["healthz"]:
+            return "healthz", None, None
+        if parts == ["stats"]:
+            return "stats", None, None
+        if parts == ["metrics"]:
+            return "metrics", None, None
+        if parts == ["debug", "profile"]:
+            return "profile", None, None
+        if len(parts) == 2 and parts[0] == "result":
+            return "result", parts[1], None     # parts[1] is the ticket id
+        if len(parts) == 2 and parts[0] == "stream":
+            return "stream", parts[1], None
+        if parts and parts[0] == "sessions":
+            if len(parts) == 1:
+                return "sessions", None, None
+            if len(parts) == 2:
+                return "session", parts[1], None
+            if len(parts) == 3:
+                return "session", parts[1], parts[2]
+        return "unknown", None, None
+
+    # -- the guarded handler (routing + error mapping) ---------------------
+
+    def _guard(self, req: Request, rid: int, obs, transport: str):
+        kind, sid, verb = self._route(req)
+        try:
+            return self._handle(req, rid, obs, transport, kind, sid, verb)
+        except wire.WireError as e:
+            return json_response(400, {"error": str(e)})
+        except KeyError:
+            what = "ticket" if kind == "result" else "session"
+            return json_response(404, {"error": f"no {what} {sid!r}"})
+        except (DeadlineError, EngineUnavailableError, EngineStepError,
+                TicketQueueFullError) as e:
+            # fault-tolerance outcomes: the session survives; 503 tells
+            # the client "try again / try later", never "you sent garbage"
+            return json_response(503, {"error": str(e), "request_id": rid})
+        except (ConfigError, ValueError) as e:
+            return json_response(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the structured-500 backstop
+            # a bug must answer structured JSON on a live connection,
+            # never a stock HTML traceback page.  The traceback goes to
+            # stderr under the request id, not the wire.
+            print(f"[mpi_tpu] request {rid}: unhandled "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            payload = {
+                "error": f"internal server error ({type(e).__name__})",
+                "request_id": rid,
+            }
+            if obs is not None:
+                # flush the evidence: the ring (or live --trace-log)
+                # holds the request's spans up to the failure point
+                dump = obs.tracer.dump_on_crash(
+                    f"request {rid}: {type(e).__name__}: {e}")
+                if dump:
+                    payload["trace_dump"] = dump
+                    print(f"[mpi_tpu] request {rid}: trace dumped to "
+                          f"{dump}", file=sys.stderr)
+            return json_response(500, payload)
+
+    def _handle(self, req: Request, rid: int, obs, transport: str,
+                kind: str, sid: Optional[str], verb: Optional[str]):
+        mgr = self.manager
+        method = req.method
+        # body bound FIRST — before any read, any route work that might
+        # read, and without trusting the route to exist (an oversized
+        # body on a bogus path is still an oversized body)
+        n = self._content_length(req)
+        if n > self.max_body:
+            return json_response(413, {
+                "error": f"request body is {n} bytes; the server accepts "
+                         f"at most {self.max_body} (--http-max-body)",
+                "max_body": self.max_body,
+            }, close=True)
+        if kind == "metrics" and method == "GET":
+            if obs is None:
+                return json_response(404, {
+                    "error": "observability is disabled (--no-obs)"})
+            text = obs.render_metrics()
+            return Response(200, text.encode("utf-8"),
+                            "text/plain; version=0.0.4; charset=utf-8")
+        if kind == "profile" and method == "POST":
+            return self._profile(req)
+        if kind == "healthz" and method == "GET":
+            health = mgr.health()
+            return json_response(200 if health["ok"] else 503, health)
+        if kind == "stats" and method == "GET":
+            return json_response(200, mgr.stats())
+        if kind == "sessions" and method == "POST":
+            body = self._body(req, transport)
+            timeout_s = self._timeout_override(req, body)
+            return json_response(200, mgr.create(body, timeout_s=timeout_s))
+        if kind == "result" and method == "GET" and sid is not None:
+            result = mgr.ticket_result(
+                sid, wait=self._query_flag(req, "wait"),
+                timeout_s=self._timeout_override(req, {}))
+            if result.get("status") == "done" and self._wants_binary(req):
+                # the ticket's outcome as one binary frame of the
+                # session's CURRENT grid (which may be further along
+                # than this ticket if later tickets already committed —
+                # same read-your-ticket semantics as snapshot-after-wait)
+                return self._binary_snapshot(result["id"], req, transport)
+            return json_response(200, result)
+        if kind == "stream" and method == "GET" and sid is not None:
+            if transport != "aio":
+                return json_response(501, {
+                    "error": "streaming needs the selector front "
+                             "(start the server with --front aio)"})
+            mgr.get(sid)                # unknown session -> 404 at setup
+            qs = parse_qs(urlsplit(req.path).query)
+            raw = qs["every"][0] if "every" in qs else "1"
+            try:
+                every = int(raw)
+            except (TypeError, ValueError):
+                raise ConfigError(f"every must be an int, got {raw!r}")
+            if every < 1:
+                raise ConfigError(f"every must be >= 1, got {every}")
+            return StreamPlan(sid, every)
+        if kind == "session" and sid is not None:
+            if method == "POST" and verb == "step":
+                body = self._body(req, transport)
+                timeout_s = self._timeout_override(req, body)
+                steps = body.get("steps", 1)
+                if not isinstance(steps, int):
+                    raise ConfigError(f"steps must be an int, got {steps!r}")
+                if self._query_flag(req, "async") or bool(body.get("async")):
+                    return json_response(200, mgr.step_async(
+                        sid, steps, timeout_s=timeout_s))
+                return json_response(
+                    200, mgr.step(sid, steps, timeout_s=timeout_s))
+            if method == "PUT" and verb == "board":
+                return self._write_board(req, sid, transport)
+            if method == "GET" and verb == "snapshot":
+                timeout_override = self._timeout_override(req, {})
+                if self._wants_binary(req):
+                    return self._binary_snapshot(sid, req, transport,
+                                                 timeout_s=timeout_override)
+                grid, generation, config = mgr.snapshot_array(
+                    sid, timeout_s=timeout_override)
+                t0 = time.perf_counter()
+                payload = {"id": sid, "generation": generation,
+                           "rows": config.rows, "cols": config.cols,
+                           "grid": format_grid_rows(grid)}
+                body = json.dumps(payload).encode()
+                self._observe_encode(t0, "json", transport)
+                return Response(200, body, "application/json")
+            if method == "GET" and verb == "density":
+                return json_response(200, mgr.density(
+                    sid, timeout_s=self._timeout_override(req, {})))
+            if method == "DELETE" and verb is None:
+                return json_response(200, mgr.close(
+                    sid, timeout_s=self._timeout_override(req, {})))
+        return json_response(404, {"error": f"no route {method} {req.path}"})
+
+    # -- wire-format helpers -----------------------------------------------
+
+    def _observe_encode(self, t0: float, fmt: str, transport: str) -> None:
+        if self.obs is not None:
+            self.obs.wire_encode.observe(time.perf_counter() - t0,
+                                         format=fmt, transport=transport)
+
+    def _observe_decode(self, t0: float, fmt: str, transport: str) -> None:
+        if self.obs is not None:
+            self.obs.wire_decode.observe(time.perf_counter() - t0,
+                                         format=fmt, transport=transport)
+
+    def _binary_snapshot(self, sid: str, req: Request, transport: str,
+                         timeout_s: Optional[float] = None) -> Response:
+        grid, generation, config = self.manager.snapshot_array(
+            sid, timeout_s=timeout_s)
+        t0 = time.perf_counter()
+        frame = self.encode_grid_frame(grid, generation, config)
+        self._observe_encode(t0, "binary", transport)
+        return Response(200, frame, wire.GRID_MEDIA_TYPE)
+
+    def encode_grid_frame(self, grid, generation, config) -> bytes:
+        """One binary frame for a session grid (snapshot, ticket result,
+        and the aio front's stream pushes all come through here)."""
+        return wire.encode_frame(grid, generation=generation,
+                                 rule=config.rule, boundary=config.boundary)
+
+    def _write_board(self, req: Request, sid: str,
+                     transport: str) -> Response:
+        if self._sends_binary(req):
+            raw = self._raw_body(req, transport)
+            t0 = time.perf_counter()
+            grid, meta = wire.decode_frame(raw)
+            self._observe_decode(t0, "binary", transport)
+            generation = (meta["generation"] if meta["has_generation"]
+                          else None)
+            timeout_s = self._timeout_override(req, {})
+        else:
+            body = self._body(req, transport)
+            timeout_s = self._timeout_override(req, body)
+            if "grid" not in body:
+                raise ConfigError('board write needs a "grid" key '
+                                  "(or a binary frame body)")
+            t0 = time.perf_counter()
+            grid = parse_grid_rows(body["grid"])
+            self._observe_decode(t0, "json", transport)
+            generation = body.get("generation")
+            if generation is not None and not isinstance(generation, int):
+                raise ConfigError(
+                    f"generation must be an int, got {generation!r}")
+        return json_response(200, self.manager.write_board(
+            sid, grid, generation=generation, timeout_s=timeout_s))
+
+    def _profile(self, req: Request) -> Response:
+        logdir = self.profile_dir
+        if logdir is None:
+            return json_response(404, {
+                "error": "profiling is disabled "
+                         "(start the server with --profile-dir)"})
+        qs = parse_qs(urlsplit(req.path).query)
+        raw = qs["secs"][0] if "secs" in qs else "1"
+        try:
+            secs = float(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(f"secs must be a number, got {raw!r}")
+        from mpi_tpu.obs.profile import run_profile
+
+        result = run_profile(logdir, secs)
+        return json_response(200 if result["ok"] else 503, result)

@@ -1,0 +1,220 @@
+"""Binary wire protocol for grid payloads — the serving stack's ONE codec.
+
+The JSON front ships a 4096x4096 board as ~16.8 MB of '0'/'1' row
+strings (snapshot) or, on the persistence path, as base64 of
+``np.packbits`` (+33% inflation plus a decode copy).  This module is the
+single packbits core both paths share, plus a self-describing binary
+*frame* the HTTP fronts negotiate via ``Accept``/``Content-Type:
+application/x-gol-grid``: a fixed little-endian header followed by the
+raw packed payload — 1 bit per cell on the wire, no base64, no JSON
+framing, decodable with one ``struct.unpack_from`` and one
+``np.frombuffer`` (no copy until ``unpackbits``).
+
+Frame layout (32-byte header, little-endian, then the payload)::
+
+    offset  size  field
+    0       4     magic            b"GOLW"
+    4       1     version          1
+    5       1     flags            bit 0: generation field is meaningful
+    6       2     boundary id      0 unknown, 1 periodic, 2 dead
+    8       4     rule id          crc32 of str(rule); 0 unknown
+    12      4     rows
+    16      4     cols
+    20      8     generation
+    28      4     payload length   must equal ceil(rows*cols/8)
+
+The rule/boundary ids are *tags*, not negotiation: the payload's meaning
+is fixed by rows x cols packed row-major bits; the ids let a consumer
+sanity-check which world a frame came from without a side channel.
+Every malformed input — short buffer, wrong magic/version, a header
+whose dimensions exceed :data:`MAX_CELLS` or disagree with the payload
+length, trailing garbage — raises :class:`WireError` (a ``ValueError``,
+so the HTTP layer maps it to a structured 400).
+
+``serve/recovery.py``'s ``encode_grid``/``decode_grid`` are thin JSON
+wrappers over :func:`pack_grid`/:func:`unpack_grid`, so checkpoint
+records and wire frames can never disagree about packing
+(``tests/test_wire.py`` pins old-record compatibility).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"GOLW"
+VERSION = 1
+FLAG_GENERATION = 0x01
+
+# magic, version, flags, boundary id, rule id, rows, cols, generation,
+# payload length — 32 bytes, no padding ("<" disables alignment)
+HEADER = struct.Struct("<4sBBHIIIQI")
+HEADER_LEN = HEADER.size
+assert HEADER_LEN == 32
+
+# A frame header may promise at most this many cells (a 65536^2 board is
+# 2^32; one binade of headroom).  Anything larger is an oversized-header
+# attack or corruption, rejected before any allocation is sized off it.
+MAX_CELLS = 1 << 34
+
+GRID_MEDIA_TYPE = "application/x-gol-grid"
+STREAM_MEDIA_TYPE = "application/x-gol-grid-stream"
+
+_BOUNDARY_IDS = {"periodic": 1, "dead": 2}
+_BOUNDARY_NAMES = {v: k for k, v in _BOUNDARY_IDS.items()}
+
+
+class WireError(ValueError):
+    """A malformed binary frame (bad magic/version/geometry/length).
+    Maps to HTTP 400 — the client sent garbage, the session is fine."""
+
+
+# -- the shared packbits core (recovery's JSON wrappers sit on these) ----
+
+
+def pack_grid(grid: np.ndarray) -> bytes:
+    """Row-major 1-bit packing of a 0/1 grid: ceil(rows*cols/8) bytes."""
+    arr = np.asarray(grid, dtype=np.uint8)
+    if arr.ndim != 2:
+        raise WireError(f"grid must be 2-D, got shape {arr.shape}")
+    return np.packbits(arr, axis=None).tobytes()
+
+
+def unpack_grid(raw: bytes, rows: int, cols: int) -> np.ndarray:
+    """Inverse of :func:`pack_grid` for a known geometry."""
+    rows, cols = int(rows), int(cols)
+    need = payload_len(rows, cols)
+    if len(raw) != need:
+        raise WireError(
+            f"packed payload is {len(raw)} bytes, {rows}x{cols} needs {need}")
+    bits = np.unpackbits(np.frombuffer(raw, dtype=np.uint8),
+                         count=rows * cols)
+    return bits.reshape(rows, cols)
+
+
+def payload_len(rows: int, cols: int) -> int:
+    return (rows * cols + 7) // 8
+
+
+# -- header tags ---------------------------------------------------------
+
+
+def boundary_id(boundary: Optional[str]) -> int:
+    return _BOUNDARY_IDS.get(boundary, 0) if boundary else 0
+
+
+def boundary_name(bid: int) -> Optional[str]:
+    return _BOUNDARY_NAMES.get(int(bid))
+
+
+def rule_id(rule) -> int:
+    """A stable 32-bit tag for a rule: crc32 of its canonical string
+    (``str(Rule)`` round-trips through ``rule_from_name``).  0 = none."""
+    if rule is None:
+        return 0
+    tag = zlib.crc32(str(rule).encode("utf-8")) & 0xFFFFFFFF
+    return tag or 1                     # 0 is reserved for "unspecified"
+
+
+# -- frames --------------------------------------------------------------
+
+
+def encode_frame(grid: np.ndarray, *, generation: Optional[int] = None,
+                 rule=None, boundary: Optional[str] = None) -> bytes:
+    """One self-describing binary frame for ``grid``.  ``generation=None``
+    leaves the field 0 with :data:`FLAG_GENERATION` clear (a consumer
+    must not trust it); board writes use the flag to mean "set the
+    session's generation to this"."""
+    arr = np.asarray(grid, dtype=np.uint8)
+    if arr.ndim != 2:
+        raise WireError(f"grid must be 2-D, got shape {arr.shape}")
+    rows, cols = arr.shape
+    flags = 0 if generation is None else FLAG_GENERATION
+    payload = pack_grid(arr)
+    header = HEADER.pack(MAGIC, VERSION, flags, boundary_id(boundary),
+                         rule_id(rule), rows, cols,
+                         0 if generation is None else int(generation),
+                         len(payload))
+    return header + payload
+
+
+def parse_header(buf) -> Dict:
+    """Validate and decode the 32-byte header at the start of ``buf``.
+
+    Returns the meta dict (rows/cols/generation/flags/ids plus
+    ``payload_len`` and ``frame_len``) without touching the payload —
+    the streaming reassembly entry point: peek the header, wait for
+    ``frame_len`` bytes, then :func:`decode_frame` the exact slice."""
+    view = memoryview(buf)
+    if len(view) < HEADER_LEN:
+        raise WireError(
+            f"truncated frame header: {len(view)} of {HEADER_LEN} bytes")
+    (magic, version, flags, bid, rid, rows, cols, generation,
+     plen) = HEADER.unpack_from(view, 0)
+    if magic != MAGIC:
+        raise WireError(f"bad frame magic {bytes(magic)!r} "
+                        f"(expected {MAGIC!r})")
+    if version != VERSION:
+        raise WireError(f"unsupported frame version {version} "
+                        f"(expected {VERSION})")
+    if rows < 1 or cols < 1:
+        raise WireError(f"frame geometry must be positive, got {rows}x{cols}")
+    if rows * cols > MAX_CELLS:
+        raise WireError(
+            f"oversized frame header: {rows}x{cols} exceeds the "
+            f"{MAX_CELLS}-cell bound")
+    need = payload_len(rows, cols)
+    if plen != need:
+        raise WireError(
+            f"frame payload length {plen} disagrees with geometry "
+            f"{rows}x{cols} (expected {need})")
+    return {
+        "version": version,
+        "flags": flags,
+        "boundary_id": bid,
+        "boundary": boundary_name(bid),
+        "rule_id": rid,
+        "rows": rows,
+        "cols": cols,
+        "generation": generation,
+        "has_generation": bool(flags & FLAG_GENERATION),
+        "payload_len": plen,
+        "frame_len": HEADER_LEN + plen,
+    }
+
+
+def decode_frame(buf) -> Tuple[np.ndarray, Dict]:
+    """(grid, meta) from exactly one frame.  The buffer must hold the
+    frame and nothing else — trailing bytes are rejected (an HTTP body
+    is one frame; streams carve exact slices via :func:`parse_header`)."""
+    meta = parse_header(buf)
+    view = memoryview(buf)
+    if len(view) < meta["frame_len"]:
+        raise WireError(
+            f"truncated frame: {len(view)} of {meta['frame_len']} bytes")
+    if len(view) > meta["frame_len"]:
+        raise WireError(
+            f"trailing garbage after frame: {len(view) - meta['frame_len']} "
+            f"extra bytes")
+    grid = unpack_grid(view[HEADER_LEN:meta["frame_len"]].tobytes(),
+                       meta["rows"], meta["cols"])
+    return grid, meta
+
+
+def split_frames(buf: bytes) -> Tuple[List[Tuple[np.ndarray, Dict]], bytes]:
+    """Carve every complete frame off the front of ``buf`` — the client
+    half of stream reassembly (chunked transfer does not promise that
+    chunk boundaries align with frames).  Returns (frames, remainder);
+    a malformed header raises, a merely-incomplete tail does not."""
+    out: List[Tuple[np.ndarray, Dict]] = []
+    pos = 0
+    while len(buf) - pos >= HEADER_LEN:
+        meta = parse_header(buf[pos:pos + HEADER_LEN])
+        if len(buf) - pos < meta["frame_len"]:
+            break
+        out.append(decode_frame(buf[pos:pos + meta["frame_len"]]))
+        pos += meta["frame_len"]
+    return out, buf[pos:]

@@ -24,6 +24,7 @@ import re
 import sys
 import tempfile
 import threading
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -53,11 +54,26 @@ REQUIRED_METRICS = [
     "mpi_tpu_unit_rounds_total",
     "mpi_tpu_active_tiles",
     "mpi_tpu_active_fraction",
+    "mpi_tpu_http_bytes_in_total",
+    "mpi_tpu_http_bytes_out_total",
+    "mpi_tpu_wire_encode_seconds",
+    "mpi_tpu_wire_decode_seconds",
+]
+# ...and the families the aio front registers at construction (PR 7) —
+# present once an AioServer has attached to the manager's obs
+AIO_METRICS = [
+    "mpi_tpu_aio_open_connections",
+    "mpi_tpu_aio_parked_waiters",
+    "mpi_tpu_aio_active_streams",
+    "mpi_tpu_aio_frames_pushed_total",
+    "mpi_tpu_aio_frames_dropped_total",
 ]
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # ...and the sparse-engine step path (PR 6)
 SPARSE_SPAN_KINDS = {"sparse_step"}
+# ...and the aio stream push path (PR 7)
+WIRE_SPAN_KINDS = {"stream_push"}
 # every trace record must carry exactly these core keys
 TRACE_KEYS = {"seq", "name", "t_unix", "t_mono", "dur_s", "thread"}
 
@@ -124,7 +140,8 @@ def check_histograms(types, samples):
                 f"({counts.get((base, lk))})")
 
 
-def check_trace(path, require_async=False, require_sparse=False):
+def check_trace(path, require_async=False, require_sparse=False,
+                require_wire=False):
     """Every JSONL record well-formed; at least one http_request span
     shares its rid with a dispatch span (lifecycle reconstructable).
     ``require_async`` additionally demands the PR-5 span kinds — set by
@@ -175,6 +192,12 @@ def check_trace(path, require_async=False, require_sparse=False):
             if not 0.0 <= r["active_fraction"] <= 1.0:
                 raise ValueError(f"sparse_step active_fraction out of "
                                  f"range: {r}")
+    if require_wire:
+        seen_kinds = {r["name"] for r in recs}
+        missing_kinds = WIRE_SPAN_KINDS - seen_kinds
+        if missing_kinds:
+            raise ValueError(f"trace missing wire span kinds: "
+                             f"{sorted(missing_kinds)}")
     return len(recs), len(linked)
 
 
@@ -295,6 +318,61 @@ def main():
             raise ValueError(f"/stats lacks sparse stats for {sid_s}: "
                              f"{descs[sid_s]}")
 
+        # -- wire protocol + aio front (PR 7) --------------------------
+        # binary snapshot (wire_encode) and binary board write
+        # (wire_decode) through the threaded front, then an aio front on
+        # the SAME manager/obs: one live stream driven by a step commit,
+        # so the stream_push span and the aio metric families all emit
+        import http.client
+
+        from mpi_tpu.serve import wire as wire_mod
+        from mpi_tpu.serve.aio import make_aio_server
+
+        hc = http.client.HTTPConnection(host, port, timeout=60)
+        hc.request("GET", f"/sessions/{sid_a}/snapshot",
+                   headers={"Accept": wire_mod.GRID_MEDIA_TYPE})
+        resp = hc.getresponse()
+        frame = resp.read()
+        assert resp.status == 200, f"binary snapshot -> {resp.status}"
+        grid, meta = wire_mod.decode_frame(frame)
+        if grid.shape != (64, 64):
+            raise ValueError(f"binary snapshot shape {grid.shape}")
+        hc.request("PUT", f"/sessions/{sid_a}/board", body=frame,
+                   headers={"Content-Type": wire_mod.GRID_MEDIA_TYPE})
+        resp = hc.getresponse()
+        body = resp.read()
+        assert resp.status == 200, f"binary board write -> {resp.status}"
+        if not json.loads(body).get("written"):
+            raise ValueError(f"board write not acknowledged: {body!r}")
+        hc.close()
+
+        aio_srv = make_aio_server(port=0, manager=manager)
+        aio_thread = threading.Thread(target=aio_srv.serve_forever,
+                                      daemon=True)
+        aio_thread.start()
+        try:
+            import socket as socket_mod
+
+            ahost, aport = aio_srv.server_address[:2]
+            s = socket_mod.create_connection((ahost, aport), timeout=30)
+            s.sendall(f"GET /stream/{sid_a}?every=1 HTTP/1.1\r\n"
+                      f"Host: x\r\n\r\n".encode())
+            buf = b""
+            while b"\r\n\r\n" not in buf:       # the chunked head
+                buf += s.recv(65536)
+            step(sid_a)                          # commit -> frame push
+            deadline = time.monotonic() + 30
+            while (aio_srv.stats()["frames_pushed"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            if aio_srv.stats()["frames_pushed"] < 1:
+                raise ValueError("aio stream pushed no frames")
+            s.close()
+        finally:
+            aio_srv.shutdown()
+            aio_srv.server_close()
+            aio_thread.join(timeout=10)
+
         code, text = call("GET", "/metrics")   # final request; the counter
         assert code == 200, f"/metrics -> {code}"  # increments post-render
         types, samples = parse_prometheus(text)
@@ -303,7 +381,33 @@ def main():
         missing = [m for m in REQUIRED_METRICS if m not in types]
         if missing:
             raise ValueError(f"/metrics missing families: {missing}")
+        missing = [m for m in AIO_METRICS if m not in types]
+        if missing:
+            raise ValueError(f"/metrics missing aio families: {missing}")
         check_histograms(types, samples)
+        # the byte counters moved real payloads both ways
+        for fam in ("mpi_tpu_http_bytes_in_total",
+                    "mpi_tpu_http_bytes_out_total"):
+            if sum(v for n, _, v in samples if n == fam) <= 0:
+                raise ValueError(f"{fam} counted no bytes")
+        # the binary snapshot + board write landed in the wire
+        # histograms under their (format, transport) labels
+        for fam, fmt in (("mpi_tpu_wire_encode_seconds", "binary"),
+                         ("mpi_tpu_wire_decode_seconds", "binary")):
+            n_obs = sum(
+                v for n, labels, v in samples
+                if n == fam + "_count" and labels.get("format") == fmt
+                and labels.get("transport") == "threaded")
+            if n_obs < 1:
+                raise ValueError(
+                    f"{fam}{{format={fmt},transport=threaded}} never "
+                    f"observed")
+        pushed = sum(v for n, _, v in samples
+                     if n == "mpi_tpu_aio_frames_pushed_total")
+        if pushed < 1:
+            raise ValueError(
+                f"mpi_tpu_aio_frames_pushed_total = {pushed}, expected "
+                f">= 1 after the stream smoke")
         http_total = sum(v for n, _, v in samples
                          if n == "mpi_tpu_http_requests_total")
         # 28 requests precede the scrape, but the counter increments
@@ -351,7 +455,7 @@ def main():
         obs.close()
 
     n_recs, n_linked = check_trace(trace_log, require_async=True,
-                                   require_sparse=True)
+                                   require_sparse=True, require_wire=True)
     print(f"obs smoke OK: {len(samples)} metric samples, "
           f"{n_recs} trace records, {n_linked} request lifecycles linked "
           f"({trace_log})")

@@ -1,0 +1,324 @@
+"""``mpi_tpu.analysis`` — AST-based invariant checkers for this repo.
+
+The three worst bugs this repo has shipped were *invariant* violations
+invisible to pytest until they corrupted state: the PR-3 donation race
+(a seam stepper re-reading a donated buffer), the PR-2 torn generation
+reads (session fields read outside ``session.lock``), and the rid
+contextvar drops across thread hops that PR-4/5 had to hand-audit.
+This package turns those code-review rules into machine checks over the
+stdlib ``ast`` — no third-party linter, no runtime import of the code
+under analysis.
+
+Rules (see each module's docstring for the precise contract):
+
+* ``donation-safety``   (:mod:`.donation`) — a function that calls a
+  donating jit (``donate_argnums=`` / ``donate=True``) must not read
+  the donated name afterwards; rebinding is the safe idiom.
+* ``lock-discipline``   (:mod:`.locks`) — attributes declared shared in
+  the per-class manifest may only be touched under their declared lock;
+  multi-lock acquisition loops must sort by ``.id`` first.
+* ``traced-purity``     (:mod:`.purity`) — no ``time.*`` / ``random.*``
+  / ``np.random`` / file I/O / mutable defaults in functions reachable
+  from ``jax.jit`` / ``shard_map`` / ``pallas_call`` roots.
+* ``ctxvar-hop``        (:mod:`.ctxvar`) — thread/executor hops into
+  code that reads the rid contextvar must ``copy_context`` (or stash
+  the rid explicitly with ``set_request_id``).
+* ``obs-drift``         (:mod:`.obsreg`) — the statically-extracted
+  metric/span registry must agree with the README tables and
+  ``tools/obs_smoke.py`` in both directions.
+
+Suppressions are inline with a mandatory reason::
+
+    self.grid = g  # lint: disable=lock-discipline -- caller holds lock
+
+A suppression on a ``def`` line scopes to the whole function.  A
+suppression missing its ``-- reason`` is itself a finding.  Findings
+that cannot carry a comment (e.g. in README.md) go in the checked-in
+``baseline.json`` next to this file, each with a written reason.
+
+Runner: ``python -m mpi_tpu.analysis [--rule R] [--write-baseline]``;
+exit 0 clean, 1 findings, 2 internal error.  ``tests/test_lint.py``
+runs the same suite inside tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "Rule", "SourceFile", "Report",
+    "all_rules", "default_files", "load_baseline", "repo_root", "run",
+    "write_baseline", "BASELINE_PATH",
+]
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# `# lint: disable=rule-a,rule-b -- why this is safe`
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_\-, ]+?)\s*(?:--\s*(.*\S))?\s*$")
+
+
+def repo_root() -> str:
+    """The checkout root (the directory holding the ``mpi_tpu`` package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``rel:line:col: [rule] message``.
+
+    ``scope`` is the enclosing def's qualname (or ``<module>``) — the
+    baseline fingerprint hashes rule/rel/scope/message but NOT the line
+    number, so unrelated edits above a baselined finding don't churn it.
+    """
+
+    rule: str
+    rel: str          # repo-relative path, '/'-separated
+    line: int
+    col: int
+    message: str
+    scope: str = "<module>"
+
+    def format(self) -> str:
+        return f"{self.rel}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def fingerprint(self) -> str:
+        raw = f"{self.rule}:{self.rel}:{self.scope}:{self.message}"
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+
+class SourceFile:
+    """A parsed file plus the lint metadata every rule needs: the AST,
+    enclosing-def spans for scope attribution, and parsed suppressions."""
+
+    def __init__(self, path: str, root: str):
+        self.path = os.path.abspath(path)
+        self.rel = os.path.relpath(self.path, root).replace(os.sep, "/")
+        with open(self.path, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.path)
+        # (start, end, qualname) per def, in source order (innermost =
+        # smallest containing span)
+        self._defs: List[Tuple[int, int, str]] = []
+        self._collect_defs(self.tree, "")
+        self.line_suppress: Dict[int, Set[str]] = {}
+        self.range_suppress: List[Tuple[int, int, Set[str]]] = []
+        self.bad_suppress_lines: List[int] = []
+        self._parse_suppressions()
+
+    # -- structure -------------------------------------------------------
+
+    def _collect_defs(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = f"{prefix}{child.name}"
+                if not isinstance(child, ast.ClassDef):
+                    self._defs.append(
+                        (child.lineno, child.end_lineno or child.lineno, qual))
+                self._collect_defs(child, qual + ".")
+            else:
+                self._collect_defs(child, prefix)
+
+    def enclosing_scope(self, line: int) -> str:
+        best: Optional[Tuple[int, int, str]] = None
+        for start, end, qual in self._defs:
+            if start <= line <= end:
+                if best is None or (end - start) < (best[1] - best[0]):
+                    best = (start, end, qual)
+        return best[2] if best else "<module>"
+
+    # -- suppressions ----------------------------------------------------
+
+    def _def_span_at(self, line: int) -> Optional[Tuple[int, int]]:
+        for start, end, _qual in self._defs:
+            if start == line:
+                return (start, end)
+        return None
+
+    def _parse_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            reason = m.group(2)
+            if not reason:
+                # an unjustified suppression neither suppresses nor
+                # passes: it is a finding in its own right
+                self.bad_suppress_lines.append(i)
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            span = self._def_span_at(i)
+            if span is not None:
+                self.range_suppress.append((span[0], span[1], rules))
+            elif text.lstrip().startswith("#"):
+                # standalone comment: applies to the next non-blank line
+                j = i + 1
+                while j <= len(self.lines) and not self.lines[j - 1].strip():
+                    j += 1
+                span2 = self._def_span_at(j)
+                if span2 is not None:
+                    self.range_suppress.append((span2[0], span2[1], rules))
+                else:
+                    self.line_suppress.setdefault(j, set()).update(rules)
+            else:
+                self.line_suppress.setdefault(i, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.line_suppress.get(line, ()):
+            return True
+        return any(start <= line <= end and rule in rules
+                   for start, end, rules in self.range_suppress)
+
+    # -- diagnostics -----------------------------------------------------
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line, col = node.lineno, node.col_offset
+        return Finding(rule, self.rel, line, col, message,
+                       self.enclosing_scope(line))
+
+
+@dataclass(frozen=True)
+class Rule:
+    """A named analyzer.  ``file_check(sf)`` runs once per SourceFile;
+    ``project_check(root, files)`` runs once over the whole tree (for
+    cross-file invariants like registry drift)."""
+
+    name: str
+    doc: str
+    file_check: Optional[Callable[[SourceFile], List[Finding]]] = None
+    project_check: Optional[
+        Callable[[str, Sequence[SourceFile]], List[Finding]]] = None
+
+
+def all_rules() -> List[Rule]:
+    from mpi_tpu.analysis import ctxvar, donation, locks, obsreg, purity
+    return [donation.RULE, locks.RULE, purity.RULE, ctxvar.RULE, obsreg.RULE]
+
+
+# -- file walker ----------------------------------------------------------
+
+# tests/ are deliberately out of the default scope: fixtures there are
+# known-bad on purpose and tests poke internals lock-free by design.
+DEFAULT_SCOPE = ("mpi_tpu", "tools", "bench.py")
+
+
+def default_files(root: str) -> List[str]:
+    out: List[str] = []
+    for entry in DEFAULT_SCOPE:
+        p = os.path.join(root, entry)
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__" and
+                                 not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+# -- baseline -------------------------------------------------------------
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("fingerprints", {})
+
+
+def write_baseline(findings: Iterable[Finding],
+                   path: Optional[str] = None) -> str:
+    path = path or BASELINE_PATH
+    fps = {
+        f.fingerprint(): {
+            "rule": f.rule, "path": f.rel, "scope": f.scope,
+            "message": f.message,
+            "reason": "TODO: justify this baseline entry",
+        }
+        for f in sorted(findings, key=lambda f: (f.rel, f.line, f.rule))
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"fingerprints": fps}, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+# -- runner ---------------------------------------------------------------
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)   # actionable
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def run(root: Optional[str] = None,
+        rules: Optional[Sequence[Rule]] = None,
+        paths: Optional[Sequence[str]] = None,
+        baseline_path: Optional[str] = None,
+        use_baseline: bool = True) -> Report:
+    """Run ``rules`` (default: all) over ``paths`` (default: the repo
+    scope) and fold in suppressions and the baseline."""
+    root = os.path.abspath(root or repo_root())
+    rules = list(rules) if rules is not None else all_rules()
+    paths = list(paths) if paths is not None else default_files(root)
+
+    report = Report()
+    files: List[SourceFile] = []
+    by_rel: Dict[str, SourceFile] = {}
+    for p in paths:
+        try:
+            sf = SourceFile(p, root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            report.errors.append(f"{p}: {type(e).__name__}: {e}")
+            continue
+        files.append(sf)
+        by_rel[sf.rel] = sf
+
+    raw: List[Finding] = []
+    for sf in files:
+        for line in sf.bad_suppress_lines:
+            raw.append(sf.finding(
+                "suppression", line,
+                "lint suppression is missing its '-- reason'"))
+    for rule in rules:
+        try:
+            if rule.file_check is not None:
+                for sf in files:
+                    raw.extend(rule.file_check(sf))
+            if rule.project_check is not None:
+                raw.extend(rule.project_check(root, files))
+        except Exception as e:  # a crashing rule must fail loudly, not pass
+            report.errors.append(f"rule {rule.name}: {type(e).__name__}: {e}")
+
+    baseline = load_baseline(baseline_path) if use_baseline else {}
+    for f in sorted(raw, key=lambda f: (f.rel, f.line, f.col, f.rule)):
+        sf = by_rel.get(f.rel)
+        if sf is not None and sf.is_suppressed(f.rule, f.line):
+            report.suppressed.append(f)
+        elif f.fingerprint() in baseline:
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    return report

@@ -1,0 +1,101 @@
+"""CLI for the invariant checker suite.
+
+::
+
+    python -m mpi_tpu.analysis                  # full suite, whole repo
+    python -m mpi_tpu.analysis --rule lock-discipline mpi_tpu/serve
+    python -m mpi_tpu.analysis --write-baseline # accept current findings
+    python -m mpi_tpu.analysis --list-rules
+
+Exit codes: 0 clean (suppressed/baselined findings don't fail), 1 any
+actionable finding, 2 internal error (a rule crashed or a scanned file
+does not parse) — a broken checker must never read as a passing one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from mpi_tpu.analysis import (
+    all_rules, default_files, repo_root, run, write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_tpu.analysis",
+        description="AST-based invariant checkers (donation safety, lock "
+                    "discipline, traced purity, ctxvar hops, obs drift)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to scan (default: the "
+                             "repo's mpi_tpu/, tools/, bench.py)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record current findings as the baseline "
+                             "(then edit in the mandatory reasons)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report baselined findings too")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="findings only, no summary line")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:18s} {r.doc}")
+        return 0
+    if args.rule:
+        known = {r.name: r for r in rules}
+        unknown = [n for n in args.rule if n not in known]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+        rules = [known[n] for n in args.rule]
+
+    root = repo_root()
+    paths = None
+    if args.paths:
+        paths = []
+        for p in args.paths:
+            p = os.path.abspath(p)
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                    paths.extend(os.path.join(dirpath, f)
+                                 for f in sorted(filenames)
+                                 if f.endswith(".py"))
+            else:
+                paths.append(p)
+
+    report = run(root=root, rules=rules, paths=paths,
+                 use_baseline=not args.no_baseline)
+
+    if args.write_baseline:
+        out = write_baseline(report.findings)
+        print(f"wrote {len(report.findings)} fingerprint(s) to {out}; "
+              f"fill in the 'reason' fields before committing")
+        return 0
+
+    for f in report.findings:
+        print(f.format())
+    for e in report.errors:
+        print(f"error: {e}", file=sys.stderr)
+    if not args.quiet:
+        n_files = len(paths if paths is not None else default_files(root))
+        print(f"{len(report.findings)} finding(s) over {n_files} file(s) "
+              f"({len(report.suppressed)} suppressed, "
+              f"{len(report.baselined)} baselined)",
+              file=sys.stderr)
+    if report.errors:
+        return 2
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""``donation-safety`` — no reads of a buffer after donating it.
+
+The PR-3 bug class: a jitted program built with ``donate_argnums`` (or
+a repo helper called with ``donate=True``) may alias its input buffer
+into the output, so the *caller* must not touch the donated name after
+the call.  The safe idiom is rebinding (``grid = evolve(grid, n)``);
+the bug idiom is keeping a second reference alive::
+
+    out = evolve(grid, n)
+    band = grid[0:2, :]      # reads a buffer XLA may already have clobbered
+
+The rule is purely lexical within one function scope:
+
+1. Collect *donating callables* visible in the module —
+
+   * ``f = jax.jit(g, donate_argnums=...)`` assignments,
+   * defs decorated ``@jax.jit(donate_argnums=...)`` or
+     ``@(functools.)partial(jax.jit, ..., donate_argnums=...)``,
+   * ``f = helper(..., donate=True)`` (the ``utils.segmenting`` /
+     ``parallel.seam`` convention: argument 0 of the result donates).
+
+   Decorated *bodies* are exempt: inside the traced function the names
+   are tracer values, not buffers.
+
+2. In every other function, walk statements in source order.  A call
+   to a donating callable marks the plain-``Name`` arguments at the
+   donated positions as dead; any later load of a dead name is a
+   finding.  Assigning to the name (including the rebind in the same
+   statement) resurrects it.
+
+Attribute-resolved callables (``engine.step``) are out of scope — the
+engine API documents its own donation contract and the serve layer
+already rebinds everywhere; this rule guards the raw-jit seams where
+PR 3 actually bit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from mpi_tpu.analysis import Finding, Rule, SourceFile
+
+RULE_NAME = "donation-safety"
+
+
+def _dump(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ast.dump(node)
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Positions named by a literal ``donate_argnums=`` keyword, or
+    ``(0,)`` for a literal ``donate=True``; None if the call donates
+    nothing we can see statically."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out) if out else None
+            return None
+        if kw.arg == "donate" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return (0,)
+    return None
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    d = _dump(node)
+    return d in ("jax.jit", "jit") or d.endswith(".jit")
+
+
+def _decorator_donations(dec: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Donated positions declared by a def's decorator, if any."""
+    if not isinstance(dec, ast.Call):
+        return None
+    pos = _donated_positions(dec)
+    if pos is None:
+        return None
+    # @jax.jit(donate_argnums=...) or @partial(jax.jit, donate_argnums=...)
+    if _is_jit_name(dec.func):
+        return pos
+    fd = _dump(dec.func)
+    if fd in ("partial", "functools.partial") and dec.args \
+            and _is_jit_name(dec.args[0]):
+        return pos
+    return None
+
+
+def _collect_donating(tree: ast.Module) -> Tuple[Dict[str, Tuple[int, ...]],
+                                                 Set[int]]:
+    """Map of callable-name -> donated positions, plus the line spans of
+    decorated-donating defs (their bodies are exempt from the rule)."""
+    donating: Dict[str, Tuple[int, ...]] = {}
+    exempt_defs: Set[int] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                pos = _decorator_donations(dec)
+                if pos is not None:
+                    donating[node.name] = pos
+                    exempt_defs.add(node.lineno)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            call = node.value
+            pos = _donated_positions(call)
+            if pos is not None:
+                # f = jax.jit(g, donate_argnums=...) / f = helper(donate=True)
+                donating[node.targets[0].id] = pos
+    return donating, exempt_defs
+
+
+class _ScopeWalker:
+    """Statement-order walk of one function body tracking dead names."""
+
+    def __init__(self, sf: SourceFile, donating: Dict[str, Tuple[int, ...]]):
+        self.sf = sf
+        self.donating = donating
+        self.findings: List[Finding] = []
+
+    def walk(self, fn: ast.AST) -> None:
+        self._block(list(fn.body), {})
+
+    def _block(self, stmts: Sequence[ast.stmt], dead: Dict[str, int]) -> None:
+        for st in stmts:
+            self._stmt(st, dead)
+
+    def _stmt(self, st: ast.stmt, dead: Dict[str, int]) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs get their own walk
+        if isinstance(st, ast.Assign):
+            self._check_expr(st.value, dead)
+            dead.update(self._donations_in(st.value))
+            # targets bind after the call: `grid = evolve(grid, 1)` is
+            # the safe rebind, so clearing comes second
+            for t in st.targets:
+                self._clear_target(t, dead)
+            return
+        if isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            if st.value is not None:
+                self._check_expr(st.value, dead)
+                dead.update(self._donations_in(st.value))
+            self._clear_target(st.target, dead)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._check_expr(st.test, dead)
+            dead.update(self._donations_in(st.test))
+            # branches see the current dead set; their kills propagate
+            # (over-approximate: a name donated in either branch stays
+            # dead after — exactly the conservative direction we want)
+            self._block(st.body, dead)
+            self._block(st.orelse, dead)
+            return
+        if isinstance(st, ast.For):
+            self._check_expr(st.iter, dead)
+            dead.update(self._donations_in(st.iter))
+            self._clear_target(st.target, dead)
+            self._block(st.body, dead)
+            self._block(st.orelse, dead)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._check_expr(item.context_expr, dead)
+                dead.update(self._donations_in(item.context_expr))
+                if item.optional_vars is not None:
+                    self._clear_target(item.optional_vars, dead)
+            self._block(st.body, dead)
+            return
+        if isinstance(st, ast.Try):
+            self._block(st.body, dead)
+            for h in st.handlers:
+                self._block(h.body, dead)
+            self._block(st.orelse, dead)
+            self._block(st.finalbody, dead)
+            return
+        # expression statements, return, raise, assert, ...
+        for expr in ast.iter_child_nodes(st):
+            if isinstance(expr, ast.expr):
+                self._check_expr(expr, dead)
+                dead.update(self._donations_in(expr))
+
+    def _clear_target(self, target: ast.AST, dead: Dict[str, int]) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                dead.pop(node.id, None)
+
+    def _donations_in(self, expr: ast.expr) -> Dict[str, int]:
+        """Names this expression donates (plain-Name args at donated
+        positions of calls to known donating callables)."""
+        out: Dict[str, int] = {}
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            pos = self.donating.get(node.func.id)
+            if pos is None:
+                continue
+            for p in pos:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    out[node.args[p].id] = node.lineno
+        return out
+
+    def _check_expr(self, expr: ast.expr, dead: Dict[str, int]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                    and node.id in dead:
+                self.findings.append(self.sf.finding(
+                    RULE_NAME, node,
+                    f"'{node.id}' was donated on line {dead[node.id]} and "
+                    f"may alias the output buffer; rebind instead of "
+                    f"re-reading it"))
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    donating, exempt = _collect_donating(sf.tree)
+    if not donating:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.lineno not in exempt:
+            w = _ScopeWalker(sf, donating)
+            w.walk(node)
+            findings.extend(w.findings)
+    return findings
+
+
+RULE = Rule(
+    name=RULE_NAME,
+    doc="no reads of a name after passing it to a donating jit "
+        "(donate_argnums / donate=True); rebind instead",
+    file_check=check,
+)

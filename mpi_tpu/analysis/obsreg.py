@@ -1,0 +1,302 @@
+"""``obs-drift`` — the observability registry, extracted statically.
+
+Dashboards parse ``/metrics`` and the trace JSONL, so the set of metric
+families and span kinds is API.  Three artifacts describe it: the code
+(the only authority), the README tables, and ``tools/obs_smoke.py``'s
+runtime expectations.  This rule extracts the registry FROM THE CODE —
+no import, pure ``ast`` — and cross-checks the other two in both
+directions:
+
+* every ``.span("name")`` / ``.event("name")`` literal emitted under
+  ``mpi_tpu/`` must have a row in the README span table, and every row
+  must correspond to a real emission site (``phase:*`` names are built
+  dynamically by ``Obs.phase_sink``; the known expansions live in
+  ``KNOWN_DYNAMIC_SPANS``);
+* every backticked ``mpi_tpu_*`` token in the README (brace patterns
+  like ``mpi_tpu_http_bytes_{in,out}_total`` and ``*`` wildcards
+  expand) must resolve to registered families, and every registered
+  family must be mentioned by some token;
+* every ``mpi_tpu_*`` string literal in ``tools/obs_smoke.py`` must
+  name a registered family (modulo ``_bucket``/``_count``/``_sum``
+  sample suffixes), and every ``*SPAN_KINDS`` set element there must
+  be an emitted span kind.
+
+``extract_registry`` is also the runtime source for obs_smoke's
+required-family lists — the static and runtime gates share one
+extraction, so they cannot diverge from each other.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from mpi_tpu.analysis import (
+    Finding, Rule, SourceFile, default_files, repo_root,
+)
+
+RULE_NAME = "obs-drift"
+
+_REGISTER_KINDS = {
+    "histogram": "histogram", "counter": "counter", "gauge": "gauge",
+    "gauge_fn": "gauge", "counter_fn": "counter",
+}
+# span names assembled at runtime (Obs.phase_sink f-string) and the
+# PhaseTimer phases that feed it
+KNOWN_DYNAMIC_SPANS = {"phase:setup", "phase:steady"}
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+_FAMILY_TOKEN = re.compile(r"^mpi_tpu_[a-z0-9_{},*]+$")
+_FAMILY_LIT = re.compile(r"^mpi_tpu_[a-z0-9_]*[a-z0-9]$")
+_SAMPLE_SUFFIXES = ("_bucket", "_count", "_sum")
+
+
+def extract_registry(root: Optional[str] = None,
+                     files: Optional[Sequence[SourceFile]] = None) -> dict:
+    """The statically-extracted observability registry of the tree:
+    ``{"metrics": {family: {"kind", "module", "labels"}},
+    "spans": {name: module}}``.  Scans ``mpi_tpu/`` only — that is
+    where every registration and emission site lives."""
+    root = os.path.abspath(root or repo_root())
+    if files is None:
+        files = []
+        for p in default_files(root):
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            if rel.startswith("mpi_tpu/"):
+                try:
+                    files.append(SourceFile(p, root))
+                except (SyntaxError, OSError):
+                    continue
+    metrics: Dict[str, dict] = {}
+    spans: Dict[str, str] = {}
+    for sf in files:
+        if not sf.rel.startswith("mpi_tpu/"):
+            continue
+        attr_to_family: Dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            lit = _first_literal(node)
+            if isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth in _REGISTER_KINDS and lit and \
+                        lit.startswith("mpi_tpu_"):
+                    metrics.setdefault(lit, {
+                        "kind": _REGISTER_KINDS[meth],
+                        "module": sf.rel, "labels": set()})
+                elif meth in ("span", "event") and lit:
+                    spans.setdefault(lit, sf.rel)
+            elif isinstance(node.func, ast.Name) and node.func.id == "_span" \
+                    and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                # the serve layer's obs-optional helper: _span(obs, "name")
+                spans.setdefault(node.args[1].value, sf.rel)
+        # label keys ride on .series(...) calls against the bound handle
+        # (self.wire_encode = m.histogram(...); self.wire_encode.series(
+        # format=..., transport=...)) — map handles back to families,
+        # then collect the kwarg names
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                lit = _first_literal(node.value)
+                t = node.targets[0]
+                if lit and lit in metrics:
+                    if isinstance(t, ast.Attribute):
+                        attr_to_family[t.attr] = lit
+                    elif isinstance(t, ast.Name):
+                        attr_to_family[t.id] = lit
+        # re-walk series calls now that attr_to_family is complete
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "series":
+                tgt = node.func.value
+                fam = None
+                if isinstance(tgt, ast.Attribute):
+                    fam = attr_to_family.get(tgt.attr)
+                elif isinstance(tgt, ast.Name):
+                    fam = attr_to_family.get(tgt.id)
+                if fam in metrics:
+                    metrics[fam]["labels"].update(
+                        kw.arg for kw in node.keywords if kw.arg)
+    for fam in metrics.values():
+        fam["labels"] = sorted(fam["labels"])
+    return {"metrics": metrics, "spans": spans}
+
+
+def _first_literal(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def required_families(registry: Optional[dict] = None) -> Tuple[List[str],
+                                                                List[str]]:
+    """(core, aio) family lists for the runtime smoke: aio families are
+    the ones ``serve/aio.py`` registers at construction; everything
+    else must be present on any instrumented scrape."""
+    registry = registry or extract_registry()
+    core, aio = [], []
+    for name, info in sorted(registry["metrics"].items()):
+        (aio if info["module"] == "mpi_tpu/serve/aio.py" else core).append(name)
+    return core, aio
+
+
+# -- README cross-check ---------------------------------------------------
+
+def _expand_token(token: str) -> List[str]:
+    """``a_{b,c}_d`` -> [a_b_d, a_c_d]; trailing ``*`` kept as wildcard."""
+    parts: List[List[str]] = [[""]]
+    for seg in re.split(r"(\{[^}]*\})", token):
+        if seg.startswith("{") and seg.endswith("}"):
+            alts = seg[1:-1].split(",")
+        else:
+            alts = [seg]
+        parts = [p + [a] for p in parts for a in alts]
+        parts = [["".join(p)] for p in parts]
+    return [p[0] for p in parts]
+
+
+def _readme_span_rows(lines: Sequence[str]) -> List[Tuple[int, List[str]]]:
+    """(line_no, [span names]) per row of any table whose header's
+    first column is ``span``."""
+    rows: List[Tuple[int, List[str]]] = []
+    in_table = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not in_table:
+            if cells and cells[0].strip("`* ").lower() == "span":
+                in_table = True
+            continue
+        if set(cells[0]) <= {"-", ":", " "}:
+            continue
+        names = _BACKTICK.findall(cells[0])
+        if names:
+            rows.append((i, names))
+    return rows
+
+
+def check_tree(root: str, files: Sequence[SourceFile],
+               readme_path: Optional[str] = None,
+               smoke_path: Optional[str] = None) -> List[Finding]:
+    readme_path = readme_path or os.path.join(root, "README.md")
+    smoke_path = smoke_path or os.path.join(root, "tools", "obs_smoke.py")
+    registry = extract_registry(root, [sf for sf in files
+                                       if sf.rel.startswith("mpi_tpu/")])
+    metrics, spans = registry["metrics"], registry["spans"]
+    findings: List[Finding] = []
+
+    def mk(rel: str, line: int, msg: str) -> Finding:
+        return Finding(RULE_NAME, rel, line, 0, msg)
+
+    # -- README ----------------------------------------------------------
+    if os.path.exists(readme_path):
+        readme_rel = os.path.relpath(readme_path, root).replace(os.sep, "/")
+        with open(readme_path, "r", encoding="utf-8") as f:
+            readme = f.read()
+        rlines = readme.splitlines()
+        rows = _readme_span_rows(rlines)
+        table_spans: Dict[str, int] = {}
+        for line_no, names in rows:
+            for n in names:
+                table_spans.setdefault(n, line_no)
+        for name, line_no in sorted(table_spans.items()):
+            if name not in spans and name not in KNOWN_DYNAMIC_SPANS:
+                findings.append(mk(
+                    readme_rel, line_no,
+                    f"README span table lists '{name}' but no call site "
+                    f"under mpi_tpu/ emits it"))
+        table_line = rows[0][0] if rows else 1
+        for name, module in sorted(spans.items()):
+            if name not in table_spans:
+                findings.append(mk(
+                    readme_rel, table_line,
+                    f"span kind '{name}' (emitted by {module}) is missing "
+                    f"from the README span table"))
+        if not rows:
+            findings.append(mk(readme_rel, 1,
+                               "README has no span table (header row "
+                               "starting with 'span')"))
+        # metric-family mentions, both directions
+        mentioned: Set[str] = set()
+        for i, line in enumerate(rlines, start=1):
+            for tok in _BACKTICK.findall(line):
+                tok = tok.strip()
+                if not _FAMILY_TOKEN.match(tok):
+                    continue
+                hit = False
+                for name in _expand_token(tok):
+                    if name.endswith("*"):
+                        pref = name[:-1]
+                        matches = [f for f in metrics if f.startswith(pref)]
+                        mentioned.update(matches)
+                        hit = hit or bool(matches)
+                    elif name in metrics:
+                        mentioned.add(name)
+                        hit = True
+                if not hit:
+                    findings.append(mk(
+                        readme_rel, i,
+                        f"README mentions metric '{tok}' but no such "
+                        f"family is registered under mpi_tpu/"))
+        for name, info in sorted(metrics.items()):
+            if name not in mentioned:
+                findings.append(mk(
+                    readme_rel, 1,
+                    f"metric family '{name}' (registered by "
+                    f"{info['module']}) is not mentioned anywhere in the "
+                    f"README"))
+
+    # -- obs_smoke -------------------------------------------------------
+    if os.path.exists(smoke_path):
+        smoke_rel = os.path.relpath(smoke_path, root).replace(os.sep, "/")
+        with open(smoke_path, "r", encoding="utf-8") as f:
+            smoke_src = f.read()
+        smoke_tree = ast.parse(smoke_src, filename=smoke_path)
+        for node in ast.walk(smoke_tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                    and _FAMILY_LIT.match(node.value):
+                base = node.value
+                for suf in _SAMPLE_SUFFIXES:
+                    if base.endswith(suf) and base not in metrics:
+                        base = base[: -len(suf)]
+                        break
+                if base not in metrics:
+                    findings.append(mk(
+                        smoke_rel, node.lineno,
+                        f"obs_smoke expects metric '{node.value}' but no "
+                        f"such family is registered under mpi_tpu/"))
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("SPAN_KINDS"):
+                for elt in ast.walk(node.value):
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str) \
+                            and elt.value not in spans \
+                            and elt.value not in KNOWN_DYNAMIC_SPANS:
+                        findings.append(mk(
+                            smoke_rel, elt.lineno,
+                            f"obs_smoke requires span kind '{elt.value}' "
+                            f"but no call site under mpi_tpu/ emits it"))
+    return findings
+
+
+def check_project(root: str, files: Sequence[SourceFile]) -> List[Finding]:
+    return check_tree(root, files)
+
+
+RULE = Rule(
+    name=RULE_NAME,
+    doc="statically-extracted metric/span registry must match the README "
+        "tables and tools/obs_smoke.py expectations, both directions",
+    project_check=check_project,
+)

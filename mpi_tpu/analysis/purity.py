@@ -1,0 +1,163 @@
+"""``traced-purity`` — no host side effects inside traced code.
+
+``jax.jit`` / ``shard_map`` / ``pallas_call`` run a function ONCE at
+trace time; anything impure inside it (wall-clock reads, host RNG,
+file I/O) silently bakes a single stale value into the compiled
+program — it does not "run every step" the way it reads.  Mutable
+default arguments are the same trap one level up: state that survives
+across traces.
+
+Roots are collected per module:
+
+* defs decorated ``@jax.jit`` / ``@jit`` /
+  ``@(functools.)partial(jax.jit, ...)``;
+* functions passed by name to ``jax.jit(f, ...)``, ``shard_map(f, ...)``
+  or ``pallas_call(kernel, ...)`` call sites;
+* local ``def``s nested inside a rooted function.
+
+Reachability is an intra-module call graph on simple names (calls
+through attributes/containers are invisible — the fixtures pin what is
+and is not caught).  On every reachable function the rule flags:
+
+* calls whose target dumps as ``time.*``, ``random.*``, ``np.random.*``
+  / ``numpy.random.*``, or builtin ``open``/``print``/``input``;
+* mutable default argument values (list/dict/set displays or
+  ``list()``/``dict()``/``set()`` calls).
+
+``jax.random`` is fine (functional, key-threaded) and is not matched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from mpi_tpu.analysis import Finding, Rule, SourceFile
+
+RULE_NAME = "traced-purity"
+
+_TRACE_ENTRYPOINTS = ("jit", "shard_map", "pallas_call")
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+_IMPURE_BUILTINS = {"open", "print", "input"}
+
+
+def _dump(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ast.dump(node)
+
+
+def _is_trace_entry(func: ast.AST) -> bool:
+    d = _dump(func)
+    last = d.rsplit(".", 1)[-1]
+    return last in _TRACE_ENTRYPOINTS
+
+
+def _is_traced_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        if _is_trace_entry(dec.func):
+            return True
+        fd = _dump(dec.func)
+        if fd in ("partial", "functools.partial") and dec.args \
+                and _is_trace_entry(dec.args[0]):
+            return True
+        return False
+    return _is_trace_entry(dec)
+
+
+def _all_defs(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _roots(tree: ast.AST) -> Set[str]:
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_traced_decorator(d) for d in node.decorator_list):
+                roots.add(node.name)
+        elif isinstance(node, ast.Call) and _is_trace_entry(node.func):
+            # jax.jit(f) / shard_map(f, mesh=...) / pallas_call(kernel, ...)
+            if node.args and isinstance(node.args[0], ast.Name):
+                roots.add(node.args[0].id)
+    return roots
+
+
+def _calls_in(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+    return out
+
+
+def _reachable(tree: ast.AST) -> Dict[str, ast.AST]:
+    defs = _all_defs(tree)
+    seen: Set[str] = set()
+    frontier = list(_roots(tree) & set(defs))
+    reach: Dict[str, ast.AST] = {}
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for fn in defs[name]:
+            reach[name] = fn
+            # nested defs of a traced function trace with it
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not fn and node.name not in seen:
+                    frontier.append(node.name)
+            for callee in _calls_in(fn):
+                if callee in defs and callee not in seen:
+                    frontier.append(callee)
+    return reach
+
+
+def _mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+        and node.func.id in ("list", "dict", "set")
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    flagged: Set[int] = set()   # dedupe: a def reachable via two paths
+    for name, fn in sorted(_reachable(sf.tree).items()):
+        if fn.lineno in flagged:
+            continue
+        flagged.add(fn.lineno)
+        args = fn.args
+        for default in list(args.defaults) + \
+                [d for d in args.kw_defaults if d is not None]:
+            if _mutable_default(default):
+                findings.append(sf.finding(
+                    RULE_NAME, default,
+                    f"mutable default argument on '{name}', which is "
+                    f"reachable from a traced entry point — state "
+                    f"survives across traces"))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dump(node.func)
+            if any(d.startswith(p) for p in _IMPURE_PREFIXES) \
+                    or d in _IMPURE_BUILTINS:
+                findings.append(sf.finding(
+                    RULE_NAME, node,
+                    f"impure call '{d}' inside '{name}', which is "
+                    f"reachable from a traced entry point — it runs "
+                    f"once at trace time, not per step"))
+    return findings
+
+
+RULE = Rule(
+    name=RULE_NAME,
+    doc="no time/random/np.random/file-I/O calls or mutable defaults in "
+        "functions reachable from jit/shard_map/pallas_call",
+    file_check=check,
+)

@@ -239,7 +239,7 @@ class MicroBatcher:
             self.solo_steps += 1
             self.solo_step_s += time.perf_counter() - t0
 
-    def _step_group_batched(self, manager, group, steps: int) -> None:
+    def _step_group_batched(self, manager, group, steps: int) -> None:  # lint: disable=lock-discipline -- leader path: _run_chunk holds every rider's session.lock (id-ordered)
         """One stacked dispatch for a group of sessions sharing an engine;
         any failure falls back to stepping each board solo (the stack
         COPIES, so the per-session grids are untouched until the batch

@@ -183,7 +183,7 @@ class EngineCache:
         with self._lock:
             return self._breaker_state_locked(signature)
 
-    def _breaker_state_locked(self, signature: tuple) -> str:
+    def _breaker_state_locked(self, signature: tuple) -> str:  # lint: disable=lock-discipline -- caller holds self._lock (_locked suffix contract)
         st = self._breakers.get(signature)
         if st is None or st.opened_at is None:
             return "closed"

@@ -280,7 +280,7 @@ class Session:
         self.restored = False               # rebuilt by replay after restart
         self.last_error: Optional[str] = None
 
-    def throughput(self) -> dict:
+    def throughput(self) -> dict:  # lint: disable=lock-discipline -- scrape-time racy read: plain attribute loads, atomic under the GIL
         gens = self.generation
         cells = self.config.cells
         return {
@@ -530,7 +530,7 @@ class SessionManager:
 
     # -- checkpoint / restore ---------------------------------------------
 
-    def _persist(self, session: Session, grid_np=None) -> None:
+    def _persist(self, session: Session, grid_np=None) -> None:  # lint: disable=lock-discipline -- caller holds session.lock (step path) or the session is pre-publication (create/restore)
         """Write the session's durable record (caller holds the session
         lock on the step path; create/restore call it pre-publication).
         ``grid_np``: a freshly fetched host grid to snapshot, or None to
@@ -558,7 +558,7 @@ class SessionManager:
             print(f"note: state-dir write failed for {session.id}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
 
-    def _checkpoint(self, session: Session) -> None:
+    def _checkpoint(self, session: Session) -> None:  # lint: disable=lock-discipline -- caller holds session.lock (documented contract)
         """Persist a committed step (caller holds ``session.lock``).  The
         generation is recorded every step; the packed grid snapshot only
         every ``checkpoint_every`` generations (fetching the device grid
@@ -593,7 +593,7 @@ class SessionManager:
             print(f"[mpi_tpu] restored {self.restored_sessions} session(s) "
                   f"from {self.store.state_dir}", file=sys.stderr)
 
-    def _restore_one(self, rec: dict) -> None:
+    def _restore_one(self, rec: dict) -> None:  # lint: disable=lock-discipline -- pre-publication: the session is not in the table yet, no other thread can reach it
         config, segments = _parse_spec(rec["spec"])
         target_gen = int(rec["generation"])
         snap = rec.get("snapshot")
@@ -671,7 +671,7 @@ class SessionManager:
                   f"(last: {session.last_error})", file=sys.stderr)
         return opened
 
-    def _degrade_session(self, session: Session, reason: str) -> None:
+    def _degrade_session(self, session: Session, reason: str) -> None:  # lint: disable=lock-discipline -- deliberately lock-free: the trigger is a wedged dispatch still holding session.lock; see docstring
         """Swap ``session`` for a serial_np replacement rebuilt by
         deterministic replay at the last *committed* generation.
 
@@ -824,7 +824,7 @@ class SessionManager:
         finally:
             session.lock.release()
 
-    def _step_locked(self, session: Session, steps: int,
+    def _step_locked(self, session: Session, steps: int,  # lint: disable=lock-discipline -- caller (_step_entry) holds session.lock for the whole call
                      unit: bool = False) -> dict:
         """The solo step body; caller holds ``session.lock`` (the step
         path via :meth:`_step_entry`, the microbatch leader for
@@ -919,7 +919,7 @@ class SessionManager:
                            sid=sid, ticket=ticket.id, steps=steps)
         return {"ticket": ticket.id, "id": sid, "status": "pending"}
 
-    def ticket_result(self, tid: str, wait: bool = False,
+    def ticket_result(self, tid: str, wait: bool = False,  # lint: disable=lock-discipline -- ticket status flips exactly once under _cv; a racy read settles via event.wait, terminal states are immutable
                       timeout_s: Optional[float] = None) -> dict:
         """A ticket's current outcome.  ``wait=True`` blocks until the
         ticket resolves (bounded by the usual request budget); a

@@ -292,7 +292,7 @@ class AsyncDispatcher:
                 except ValueError:
                     pass
 
-    def _evict_locked(self) -> None:
+    def _evict_locked(self) -> None:  # lint: disable=lock-discipline -- caller holds _cv (_locked suffix contract)
         """Age out the oldest RESOLVED tickets: anything beyond the
         ``retain`` size cap, plus anything older than ``ticket_ttl_s``
         (0 = no clock).  A pending ticket is never evicted — its id must
@@ -436,7 +436,7 @@ class AsyncDispatcher:
             sig = live[0][1].plan_sig
             t1 = time.perf_counter()
 
-            def work():
+            def work():  # lint: disable=lock-discipline -- _run_group holds every participant's session.lock around the chain
                 if B == 1:
                     s = live[0][1]
                     s.engine.ensure_compiled(s.grid, 1)

@@ -1,0 +1,52 @@
+"""Known-bad donation cases the ``donation-safety`` rule must catch.
+
+``seam_step_racy`` is the minimized PR-3 seam donation race: the seam
+stitcher needs the PRE-step grid for the wrap band, but the stepper was
+built with ``donate_argnums=(0,)`` — XLA may alias the input buffer
+into the output, so the band read races the in-place step (observed as
+nondeterministic whole-shard corruption on the 8-virtual-device CPU
+mesh; the fix was ``donate=False`` for seam programs, see
+``mpi_tpu/parallel/seam.py``).
+
+Lines expected to be flagged carry ``# expect: donation-safety``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=(0,))
+def evolve(grid, steps: int = 1):
+    # the decorated body itself is traced, not a buffer read — exempt
+    return jnp.roll(grid, steps, axis=0)
+
+
+def seam_step_racy(grid, k):
+    """The PR-3 bug shape: step first, then read the pre-step band."""
+    out = evolve(grid, k)
+    band = grid[:, 0:2]                     # expect: donation-safety
+    return out, band
+
+
+def double_read(grid):
+    out = evolve(grid, 1)
+    total = grid.sum()                      # expect: donation-safety
+    return out, total
+
+
+def assigned_jit(grid):
+    step1 = jax.jit(lambda g: g, donate_argnums=0)
+    out = step1(grid)
+    return out, grid.mean()                 # expect: donation-safety
+
+
+def helper_donate_kwarg(make_local, grid):
+    stepper = segmented(make_local, 4, donate=True)
+    out = stepper(grid, 2)
+    return out, grid[0]                     # expect: donation-safety
+
+
+def segmented(make_local, k, donate=False):
+    return make_local(k)

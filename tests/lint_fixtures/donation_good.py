@@ -1,0 +1,38 @@
+"""Clean donation idioms the ``donation-safety`` rule must NOT flag."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=(0,))
+def evolve(grid, steps: int = 1):
+    return jnp.roll(grid, steps, axis=0)
+
+
+def rebind(grid, n):
+    """The safe idiom: the donated name is replaced by the output."""
+    for _ in range(n):
+        grid = evolve(grid, 1)
+    return grid
+
+
+def read_before_call(grid, k):
+    """Reading the band BEFORE the donating call is fine — the device
+    value is captured into a new buffer before the step donates."""
+    band = grid[:, 0:2]
+    grid = evolve(grid, k)
+    return grid, band
+
+
+def no_donation(grid):
+    plain = jax.jit(lambda g: g + 1)
+    out = plain(grid)
+    return out, grid.sum()      # plain jit does not donate
+
+
+def fresh_name(grid):
+    out = evolve(grid, 1)
+    out2 = evolve(out, 1)       # chaining outputs, old names never re-read
+    return out2

@@ -1,0 +1,51 @@
+"""Known-bad traced-purity cases.  Impure calls reachable from a
+traced entry point run ONCE at trace time and bake a stale value into
+the compiled program.  Flagged lines carry ``# expect: traced-purity``.
+"""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def traced_root(x):
+    return helper(x)
+
+
+def helper(x):
+    time.sleep(0.01)                        # expect: traced-purity
+    return x + random.random()              # expect: traced-purity
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + np.random.rand()  # expect: traced-purity
+
+
+def build_kernel():
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(kernel, out_shape=None)
+
+
+def leaky(x, acc=[]):                       # expect: traced-purity
+    acc.append(x)
+    return acc
+
+
+@jax.jit
+def root_mutable(x):
+    return leaky(x)
+
+
+def loads_file(x):
+    with open("data.txt") as f:             # expect: traced-purity
+        return x, f
+
+
+def shard_mapped(mesh):
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(loads_file, mesh=mesh, in_specs=None, out_specs=None)

@@ -1,0 +1,34 @@
+"""Clean traced code the ``traced-purity`` rule must NOT flag."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def traced_root(x):
+    return pure_helper(x) + jnp.sum(x)
+
+
+def pure_helper(x):
+    return jnp.roll(x, 1, axis=0)
+
+
+@jax.jit
+def keyed_random(key):
+    # jax.random is functional (key-threaded) — allowed in traces
+    return jax.random.bits(key, (8,))
+
+
+def host_timing(x):
+    """Impure, but NOT reachable from any traced entry point."""
+    t0 = time.perf_counter()
+    y = traced_root(x)
+    return y, time.perf_counter() - t0
+
+
+def host_logging(path, x):
+    with open(path, "w") as f:
+        f.write(str(x))
+    return x

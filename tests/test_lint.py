@@ -1,0 +1,192 @@
+"""Tier-1 gate for the ``mpi_tpu.analysis`` invariant-checker suite.
+
+Three layers:
+
+* the fixture corpus under ``tests/lint_fixtures/`` — every line
+  tagged ``# expect: <rule>`` must be flagged by exactly that rule,
+  the ``*_good.py`` twins must be clean, and the obsreg mini-trees
+  must drift (or not) as designed;
+* the mechanics — suppression comments, the missing-reason finding,
+  and the line-number-free baseline fingerprint round-trip;
+* the tree itself — ``run()`` over the real repo scope must be clean
+  (this is the CI gate) and finish inside the tier-1 budget.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mpi_tpu.analysis import (
+    Finding, SourceFile, all_rules, repo_root, run, write_baseline,
+)
+from mpi_tpu.analysis import obsreg
+
+ROOT = repo_root()
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+RULES = {r.name: r for r in all_rules()}
+
+# anchored at end-of-line so prose mentions of the marker syntax in
+# fixture docstrings don't count as expectations
+EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z\-]+)\s*$")
+
+
+def _expected_lines(path):
+    out = set()
+    with open(path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            if EXPECT_RE.search(line):
+                out.add(i)
+    return out
+
+
+def _run_rule(rule_name, fname):
+    path = os.path.join(FIXTURES, fname)
+    return path, run(paths=[path], rules=[RULES[rule_name]],
+                     use_baseline=False)
+
+
+FIXTURE_PAIRS = [
+    ("donation-safety", "donation_bad.py", "donation_good.py"),
+    ("lock-discipline", "locks_bad.py", "locks_good.py"),
+    ("traced-purity", "purity_bad.py", "purity_good.py"),
+    ("ctxvar-hop", "ctxvar_bad.py", "ctxvar_good.py"),
+]
+
+
+@pytest.mark.parametrize("rule,bad,_good", FIXTURE_PAIRS,
+                         ids=[p[0] for p in FIXTURE_PAIRS])
+def test_bad_fixture_fully_caught(rule, bad, _good):
+    path, rep = _run_rule(rule, bad)
+    assert not rep.errors, rep.errors
+    expected = _expected_lines(path)
+    assert expected, f"{bad} has no # expect markers — fixture is inert"
+    got = {f.line for f in rep.findings}
+    assert got == expected, (
+        f"{rule} on {bad}: flagged {sorted(got)}, "
+        f"markers at {sorted(expected)}\n"
+        + "\n".join(f.format() for f in rep.findings))
+    assert all(f.rule == rule for f in rep.findings)
+
+
+@pytest.mark.parametrize("rule,_bad,good", FIXTURE_PAIRS,
+                         ids=[p[0] for p in FIXTURE_PAIRS])
+def test_good_fixture_clean(rule, _bad, good):
+    _path, rep = _run_rule(rule, good)
+    assert not rep.errors, rep.errors
+    assert not rep.findings, "\n".join(f.format() for f in rep.findings)
+
+
+# -- obsreg mini-trees ----------------------------------------------------
+
+def _obsreg_tree(name):
+    root = os.path.join(FIXTURES, name)
+    files = [SourceFile(os.path.join(root, "mpi_tpu", "mod.py"), root)]
+    return obsreg.check_tree(
+        root, files,
+        readme_path=os.path.join(root, "README.md"),
+        smoke_path=os.path.join(root, "smoke.py"))
+
+
+def test_obsreg_consistent_tree_clean():
+    assert _obsreg_tree("obsreg_good") == []
+
+
+def test_obsreg_drifted_tree_caught():
+    msgs = [f.message for f in _obsreg_tree("obsreg_bad")]
+    for needle in [
+        "'fixture_ghost' but no call site",          # phantom README span row
+        "'fixture_orphan'",                          # span missing its row
+        "'mpi_tpu_fixture_missing_total'",           # phantom README metric
+        "'mpi_tpu_fixture_latency_seconds'",         # unmentioned family
+        "'mpi_tpu_fixture_phantom_total'",           # phantom smoke metric
+        "'fixture_ghost2'",                          # phantom smoke span
+    ]:
+        assert any(needle in m for m in msgs), (needle, msgs)
+    assert len(msgs) == 6, msgs
+
+
+# -- suppression mechanics ------------------------------------------------
+
+def test_suppression_with_reason_suppresses():
+    _path, rep = _run_rule("lock-discipline", "suppress_cases.py")
+    by_scope = {f.scope: f for f in rep.findings if f.rule == "lock-discipline"}
+    # the justified suppression lands in .suppressed, not .findings
+    assert "read_suppressed" not in by_scope
+    assert any(f.scope == "read_suppressed" for f in rep.suppressed)
+    # the control case is an ordinary finding
+    assert "read_plain" in by_scope
+
+
+def test_suppression_without_reason_is_a_finding():
+    _path, rep = _run_rule("lock-discipline", "suppress_cases.py")
+    bare = [f for f in rep.findings if f.rule == "suppression"]
+    assert len(bare) == 1 and bare[0].scope == "read_bare"
+    # ...and it does NOT suppress: the underlying finding survives too
+    assert any(f.rule == "lock-discipline" and f.scope == "read_bare"
+               for f in rep.findings)
+
+
+# -- baseline -------------------------------------------------------------
+
+def test_fingerprint_ignores_line_numbers():
+    a = Finding("r", "p.py", 10, 0, "msg", "fn")
+    b = Finding("r", "p.py", 99, 4, "msg", "fn")
+    c = Finding("r", "p.py", 10, 0, "other msg", "fn")
+    assert a.fingerprint() == b.fingerprint() != c.fingerprint()
+
+
+def test_baseline_roundtrip(tmp_path):
+    path, rep = _run_rule("donation-safety", "donation_bad.py")
+    assert rep.findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(rep.findings, str(bl))
+    rep2 = run(paths=[path], rules=[RULES["donation-safety"]],
+               baseline_path=str(bl), use_baseline=True)
+    assert rep2.clean
+    assert len(rep2.baselined) == len(rep.findings)
+
+
+# -- the real tree --------------------------------------------------------
+
+def test_repo_tree_is_clean_and_fast():
+    t0 = time.perf_counter()
+    rep = run()
+    elapsed = time.perf_counter() - t0
+    assert not rep.errors, rep.errors
+    assert not rep.findings, "\n".join(f.format() for f in rep.findings)
+    # the tier-1 budget: the whole suite must stay cheap on a 1-core box
+    assert elapsed < 5.0, f"lint suite took {elapsed:.2f}s"
+
+
+def test_extracted_registry_feeds_obs_smoke():
+    core, aio = obsreg.required_families()
+    assert core and aio
+    assert not set(core) & set(aio)
+    fam = re.compile(r"^mpi_tpu_[a-z0-9_]*[a-z0-9]$")
+    assert all(fam.match(n) for n in core + aio)
+
+
+# -- CLI exit codes -------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_tpu.analysis", *args],
+        cwd=ROOT, capture_output=True, text=True)
+
+
+def test_cli_exit_one_on_findings():
+    proc = _cli("--rule", "donation-safety", "--no-baseline",
+                os.path.join(FIXTURES, "donation_bad.py"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "donation-safety" in proc.stdout
+
+
+def test_cli_list_rules_exits_zero():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for name in RULES:
+        assert name in proc.stdout

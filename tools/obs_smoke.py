@@ -32,42 +32,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import urllib.request
 
+from mpi_tpu.analysis.obsreg import required_families
+
 # the metric families every scrape must expose (pre-registered or bound
-# at manager attach — present even before traffic touches a site)
-REQUIRED_METRICS = [
-    "mpi_tpu_dispatch_latency_seconds",
-    "mpi_tpu_batch_occupancy_boards",
-    "mpi_tpu_compile_wall_seconds",
-    "mpi_tpu_checkpoint_write_seconds",
-    "mpi_tpu_restore_replay_seconds",
-    "mpi_tpu_session_lock_wait_seconds",
-    "mpi_tpu_http_requests_total",
-    "mpi_tpu_sessions",
-    "mpi_tpu_breaker_signatures",
-    "mpi_tpu_cache_events_total",
-    "mpi_tpu_engine_counters_total",
-    "mpi_tpu_batch_queue_depth",
-    "mpi_tpu_trace_spans_total",
-    "mpi_tpu_ticket_queue_depth",
-    "mpi_tpu_tickets_pending",
-    "mpi_tpu_tickets_completed_total",
-    "mpi_tpu_unit_rounds_total",
-    "mpi_tpu_active_tiles",
-    "mpi_tpu_active_fraction",
-    "mpi_tpu_http_bytes_in_total",
-    "mpi_tpu_http_bytes_out_total",
-    "mpi_tpu_wire_encode_seconds",
-    "mpi_tpu_wire_decode_seconds",
-]
-# ...and the families the aio front registers at construction (PR 7) —
-# present once an AioServer has attached to the manager's obs
-AIO_METRICS = [
-    "mpi_tpu_aio_open_connections",
-    "mpi_tpu_aio_parked_waiters",
-    "mpi_tpu_aio_active_streams",
-    "mpi_tpu_aio_frames_pushed_total",
-    "mpi_tpu_aio_frames_dropped_total",
-]
+# at manager attach — present even before traffic touches a site), and
+# the families the aio front registers at construction (PR 7).  Both
+# lists come from the SAME static extraction the `obs-drift` lint rule
+# checks against the README — register a new family in mpi_tpu/ and
+# this runtime gate demands it on the next scrape, no hand list to
+# forget.
+REQUIRED_METRICS, AIO_METRICS = required_families()
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # ...and the sparse-engine step path (PR 6)
@@ -462,9 +436,35 @@ def main():
     return 0
 
 
+def run_lint() -> None:
+    """The static half of the drift gate: the same registry extraction
+    that feeds REQUIRED_METRICS, cross-checked against the README and
+    this file by ``mpi_tpu.analysis`` — plus the other invariant rules.
+    Raises (-> exit 1) on any finding, same contract as the runtime
+    smoke."""
+    from mpi_tpu.analysis import run as lint_run
+
+    rep = lint_run()
+    for f in rep.findings:
+        print(f.format(), file=sys.stderr)
+    for e in rep.errors:
+        print(f"lint error: {e}", file=sys.stderr)
+    if not rep.clean:
+        raise ValueError(
+            f"static analysis not clean: {len(rep.findings)} finding(s), "
+            f"{len(rep.errors)} error(s)")
+    print(f"lint OK: 0 findings ({len(rep.suppressed)} suppressed, "
+          f"{len(rep.baselined)} baselined)")
+
+
 if __name__ == "__main__":
     try:
-        sys.exit(main())
+        # --lint: run the static drift gate before the runtime smoke so
+        # one invocation fails loudly on either side; --lint-only skips
+        # the (slower) serve loop for pure-static CI hooks
+        if "--lint" in sys.argv or "--lint-only" in sys.argv:
+            run_lint()
+        sys.exit(main() if "--lint-only" not in sys.argv else 0)
     except Exception as e:  # noqa: BLE001 — nonzero exit IS the contract
         print(f"obs smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(1)

@@ -27,14 +27,18 @@ Rules (see each module's docstring for the precise contract):
   metric/span registry must agree with the README tables and
   ``tools/obs_smoke.py`` in both directions.
 
-Suppressions are inline with a mandatory reason::
+Suppressions are inline with a mandatory reason (``<rule>`` stands for
+a real rule name; the literal form is ``lint: disable=`` + the name)::
 
-    self.grid = g  # lint: disable=lock-discipline -- caller holds lock
+    self.grid = g  # lint: disable=<rule> -- caller holds lock
 
 A suppression on a ``def`` line scopes to the whole function.  A
-suppression missing its ``-- reason`` is itself a finding.  Findings
-that cannot carry a comment (e.g. in README.md) go in the checked-in
-``baseline.json`` next to this file, each with a written reason.
+suppression missing its ``-- reason`` is itself a finding, and so is a
+*stale* one: a suppression naming a rule that produces no finding in
+its scope is reported as unused (suppressions rot silently otherwise).
+Findings that cannot carry a comment (e.g. in README.md) go in the
+checked-in ``baseline.json`` next to this file, each with a written
+reason.
 
 Runner: ``python -m mpi_tpu.analysis [--rule R] [--write-baseline]``;
 exit 0 clean, 1 findings, 2 internal error.  ``tests/test_lint.py``
@@ -52,14 +56,15 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
-    "Finding", "Rule", "SourceFile", "Report",
+    "Finding", "Rule", "SourceFile", "Report", "Suppression",
     "all_rules", "default_files", "load_baseline", "repo_root", "run",
     "write_baseline", "BASELINE_PATH",
 ]
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
 
-# `# lint: disable=rule-a,rule-b -- why this is safe`
+# `# lint: disable=<rule-a>,<rule-b> -- why this is safe` (with real
+# rule names in place of the angle-bracket placeholders)
 _SUPPRESS_RE = re.compile(
     r"#\s*lint:\s*disable=([A-Za-z0-9_\-, ]+?)\s*(?:--\s*(.*\S))?\s*$")
 
@@ -68,6 +73,23 @@ def repo_root() -> str:
     """The checkout root (the directory holding the ``mpi_tpu`` package)."""
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# lint: disable=`` comment: the line it sits on, the
+    [start, end] line range it applies to, the rules it names, and —
+    filled in during :func:`run` — which of those rules it actually
+    suppressed a finding for.  Rules never hit are stale and reported."""
+
+    line: int                 # where the comment lives (for diagnostics)
+    start: int                # first line it covers
+    end: int                  # last line it covers (== start for one line)
+    rules: Set[str]
+    used: Set[str] = field(default_factory=set)
+
+    def covers(self, rule: str, line: int) -> bool:
+        return rule in self.rules and self.start <= line <= self.end
 
 
 @dataclass(frozen=True)
@@ -109,8 +131,7 @@ class SourceFile:
         # smallest containing span)
         self._defs: List[Tuple[int, int, str]] = []
         self._collect_defs(self.tree, "")
-        self.line_suppress: Dict[int, Set[str]] = {}
-        self.range_suppress: List[Tuple[int, int, Set[str]]] = []
+        self.suppressions: List[Suppression] = []
         self.bad_suppress_lines: List[int] = []
         self._parse_suppressions()
 
@@ -158,7 +179,8 @@ class SourceFile:
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
             span = self._def_span_at(i)
             if span is not None:
-                self.range_suppress.append((span[0], span[1], rules))
+                self.suppressions.append(
+                    Suppression(i, span[0], span[1], rules))
             elif text.lstrip().startswith("#"):
                 # standalone comment: applies to the next non-blank line
                 j = i + 1
@@ -166,17 +188,23 @@ class SourceFile:
                     j += 1
                 span2 = self._def_span_at(j)
                 if span2 is not None:
-                    self.range_suppress.append((span2[0], span2[1], rules))
+                    self.suppressions.append(
+                        Suppression(i, span2[0], span2[1], rules))
                 else:
-                    self.line_suppress.setdefault(j, set()).update(rules)
+                    self.suppressions.append(Suppression(i, j, j, rules))
             else:
-                self.line_suppress.setdefault(i, set()).update(rules)
+                self.suppressions.append(Suppression(i, i, i, rules))
 
     def is_suppressed(self, rule: str, line: int) -> bool:
-        if rule in self.line_suppress.get(line, ()):
-            return True
-        return any(start <= line <= end and rule in rules
-                   for start, end, rules in self.range_suppress)
+        """Whether (rule, line) is covered — and mark every covering
+        suppression as *used* for that rule (the unused-suppression
+        check reads the leftovers)."""
+        hit = False
+        for sup in self.suppressions:
+            if sup.covers(rule, line):
+                sup.used.add(rule)
+                hit = True
+        return hit
 
     # -- diagnostics -----------------------------------------------------
 
@@ -321,4 +349,29 @@ def run(root: Optional[str] = None,
             report.baselined.append(f)
         else:
             report.findings.append(f)
+
+    # stale suppressions: every parsed suppression has now seen every
+    # finding of this run; a named rule it never suppressed is rot (or a
+    # typo — an unknown rule name can never match).  Only rules that
+    # actually ran are judged: `--rule lock-discipline` must not flag
+    # the tree's justified traced-purity suppressions as unused.
+    active = {r.name for r in rules}
+    known = {r.name for r in all_rules()}
+    for sf in files:
+        for sup in sf.suppressions:
+            for rule_name in sorted((sup.rules & active) - sup.used):
+                f = sf.finding(
+                    "unused-suppression", sup.line,
+                    f"suppression for '{rule_name}' matches no finding "
+                    f"— remove it (stale suppressions hide future "
+                    f"regressions)")
+                (report.findings if f.fingerprint() not in baseline
+                 else report.baselined).append(f)
+            for rule_name in sorted(sup.rules - known):
+                f = sf.finding(
+                    "unused-suppression", sup.line,
+                    f"suppression names unknown rule '{rule_name}' "
+                    f"(typo? see --list-rules)")
+                (report.findings if f.fingerprint() not in baseline
+                 else report.baselined).append(f)
     return report

@@ -1,0 +1,175 @@
+"""``mpi_tpu.analysis.ir`` — jaxpr-level contract verifier.
+
+The AST suite (:mod:`mpi_tpu.analysis`) judges *syntax*; the bug classes
+this repo actually shipped (the PR-3 seam donation race, EngineCache
+keying subtleties) live in the *traced program*.  This package traces
+engine-built steppers abstractly — ``jax.make_jaxpr`` + ``.lower()``,
+no device execution — over a config matrix (:mod:`.matrix`) and holds
+the IR to five contracts (:mod:`.checks`):
+
+* ``ir-donation``   — seam-stitched programs carry NO input/output
+  aliasing; every other stepper MUST (both directions of the PR-3 class,
+  read off the lowered IR's donor markers rather than the source).
+* ``ir-collective`` — every ``ppermute`` is a (partial) bijection over
+  its named mesh axis, closes the full ring on periodic boundaries, and
+  ships slabs exactly one halo depth thick
+  (:func:`mpi_tpu.parallel.halo.expected_slab_depths`).
+* ``ir-purity``     — no callback/debug/io primitives reachable in a
+  production stepper's trace.
+* ``ir-signature``  — ``plan_signature`` soundness both ways: equal
+  (signature, depth, B) ⇒ identical canonical jaxprs; matrix near-pairs
+  differing in one signature-visible field ⇒ different signatures.
+* ``ir-drift``      — canonical jaxpr fingerprints (:mod:`.canon`) per
+  matrix cell against the checked-in ``baseline.json``; bless
+  intentional changes with ``--write-baseline``.
+
+Runner: ``python -m mpi_tpu.analysis.ir`` (exit 0 clean / 1 findings /
+2 internal error, same contract as ``python -m mpi_tpu.analysis``).
+``--fast`` runs the tier-1 subset; ``tests/test_ir_verify.py`` runs the
+same subset inside tier-1.
+
+Unlike the AST suite there are no inline suppressions here — a traced
+program has no comment to hang one on.  The only accepted override is
+the baseline (for drift) and fixing the engine (for everything else).
+
+This module deliberately defers every jax import into function bodies:
+``python -m mpi_tpu.analysis.ir`` must pin ``JAX_PLATFORMS=cpu`` and the
+8-device virtual mesh *before* jax initializes, and ``python -m`` imports
+this package ahead of ``__main__``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "BASELINE_PATH", "IRReport", "force_cpu_mesh", "load_baseline",
+    "run_ir", "write_baseline",
+]
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def force_cpu_mesh() -> None:
+    """Pin jax to the 8-device virtual CPU mesh the matrix traces on.
+
+    Must run before jax initializes a backend (the ambient axon
+    sitecustomize pins ``jax_platforms`` to the real TPU at interpreter
+    start, so the config update is needed on top of the env vars —
+    same dance as tests/conftest.py)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+# -- baseline -------------------------------------------------------------
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    """cell_id -> {"fingerprint": ...} from the checked-in baseline."""
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("cells", {})
+
+
+def write_baseline(traced, path: Optional[str] = None) -> str:
+    """Bless the traced cells' canonical fingerprints as the baseline."""
+    path = path or BASELINE_PATH
+    cells = {
+        tc.cell.id: {"fingerprint": tc.fingerprint, "tier": tc.cell.tier}
+        for tc in sorted(traced, key=lambda tc: tc.cell.id)
+    }
+    payload = {
+        "comment": "Canonical jaxpr fingerprints per matrix cell "
+                   "(mpi_tpu/analysis/ir/canon.py). Regenerate with "
+                   "`python -m mpi_tpu.analysis.ir --write-baseline` "
+                   "and justify the drift in the commit message.",
+        "cells": cells,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+# -- runner ---------------------------------------------------------------
+
+@dataclass
+class IRReport:
+    findings: List = field(default_factory=list)   # List[IRFinding]
+    errors: List[str] = field(default_factory=list)
+    traced: List = field(default_factory=list)     # List[TracedCell]
+    complete: bool = False   # full matrix (drift may judge staleness)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+    def to_json(self) -> dict:
+        return {
+            "tool": "mpi_tpu.analysis.ir",
+            "findings": [
+                {"check": f.check, "cell": f.cell, "message": f.message,
+                 "fingerprint": f.fingerprint()}
+                for f in self.findings
+            ],
+            "errors": list(self.errors),
+            "summary": {
+                "cells_traced": len(self.traced),
+                "findings": len(self.findings),
+                "errors": len(self.errors),
+                "complete_matrix": self.complete,
+            },
+            "cells": {
+                tc.cell.id: tc.fingerprint for tc in self.traced
+            },
+        }
+
+
+def run_ir(fast_only: bool = False,
+           cell_ids: Optional[Sequence[str]] = None,
+           use_baseline: bool = True,
+           baseline_path: Optional[str] = None,
+           signature_fn=None) -> IRReport:
+    """Trace the selected matrix cells and run every IR check.
+
+    ``signature_fn`` overrides the plan-signature keying for the
+    soundness check — the seeded-collision tests inject one with a field
+    dropped and pin the resulting diagnostic."""
+    from mpi_tpu.analysis.ir import checks
+    from mpi_tpu.analysis.ir.harness import HarnessError, trace_cell
+    from mpi_tpu.analysis.ir.matrix import cell_by_id, cells
+
+    if cell_ids:
+        selected = [cell_by_id(c) for c in cell_ids]
+    else:
+        selected = cells(fast_only=fast_only)
+
+    report = IRReport(complete=not fast_only and not cell_ids)
+    for cell in selected:
+        try:
+            report.traced.append(trace_cell(cell))
+        except HarnessError as e:
+            report.errors.append(str(e))
+
+    for tc in report.traced:
+        report.findings.extend(checks.check_donation(tc))
+        report.findings.extend(checks.check_collectives(tc))
+        report.findings.extend(checks.check_purity(tc))
+    report.findings.extend(
+        checks.check_signatures(report.traced, signature_fn=signature_fn))
+    if use_baseline:
+        report.findings.extend(checks.check_drift(
+            report.traced, load_baseline(baseline_path),
+            complete=report.complete))
+    return report

@@ -1,0 +1,101 @@
+"""CLI for the IR-level contract verifier.
+
+::
+
+    python -m mpi_tpu.analysis.ir                   # full matrix
+    python -m mpi_tpu.analysis.ir --fast            # tier-1 subset
+    python -m mpi_tpu.analysis.ir --cell seam_1x1   # one cell (repeatable)
+    python -m mpi_tpu.analysis.ir --write-baseline  # bless current IR
+    python -m mpi_tpu.analysis.ir --format json     # machine-readable
+    python -m mpi_tpu.analysis.ir --list-cells
+
+Exit codes match ``python -m mpi_tpu.analysis``: 0 clean, 1 any finding,
+2 internal error (a cell failed to trace) — a broken verifier must never
+read as a passing one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from mpi_tpu.analysis.ir import force_cpu_mesh, run_ir, write_baseline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_tpu.analysis.ir",
+        description="jaxpr-level contract verifier (donation aliasing, "
+                    "collective validity, IR purity, plan_signature "
+                    "soundness, IR drift)")
+    parser.add_argument("--fast", action="store_true",
+                        help="trace only the tier-1 fast subset of the "
+                             "matrix")
+    parser.add_argument("--cell", action="append", default=None,
+                        metavar="ID", help="trace only this matrix cell "
+                                           "(repeatable; see --list-cells)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="bless the current canonical fingerprints as "
+                             "analysis/ir/baseline.json")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="skip the drift check")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human",
+                        help="diagnostic format (default: human)")
+    parser.add_argument("--list-cells", action="store_true")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="findings only, no summary line")
+    args = parser.parse_args(argv)
+
+    if args.list_cells:
+        from mpi_tpu.analysis.ir.matrix import CELLS
+
+        for c in CELLS:
+            batched = f" B={c.batch}" if c.batch else ""
+            print(f"{c.id:24s} [{c.tier:4s}] {c.rows}x{c.cols} "
+                  f"rule={c.rule} boundary={c.boundary} mesh={c.mesh} "
+                  f"K={c.comm_every} sparse={c.sparse_tile} "
+                  f"depth={c.depth}{batched}")
+        return 0
+
+    force_cpu_mesh()
+    try:
+        report = run_ir(
+            fast_only=args.fast, cell_ids=args.cell,
+            # a baseline run judges the *other* checks first; drift
+            # against the stale baseline would be pure noise
+            use_baseline=not (args.no_baseline or args.write_baseline))
+    except KeyError as e:   # unknown --cell id
+        print(str(e.args[0]) if e.args else str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if report.errors:
+            for e in report.errors:
+                print(f"error: {e}", file=sys.stderr)
+            print("refusing to write a baseline from a partial trace",
+                  file=sys.stderr)
+            return 2
+        out = write_baseline(report.traced)
+        print(f"wrote {len(report.traced)} cell fingerprint(s) to {out}")
+        return 0
+
+    if args.format == "json":
+        json.dump(report.to_json(), sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        for f in report.findings:
+            print(f.format())
+        for e in report.errors:
+            print(f"error: {e}", file=sys.stderr)
+        if not args.quiet:
+            print(f"{len(report.findings)} finding(s) over "
+                  f"{len(report.traced)} traced cell(s)", file=sys.stderr)
+    if report.errors:
+        return 2
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""Canonical jaxpr serialization + fingerprinting for the IR verifier.
+
+The drift baseline (``analysis/ir/baseline.json``) stores one
+fingerprint per matrix cell; for that to be useful the fingerprint must
+be *stable* under everything that does not change the traced program:
+
+* **var identity** — every trace mints fresh ``Var`` objects, and jax's
+  pretty-printer names them by a global counter, so two identical traces
+  print differently.  We rename vars ``v0, v1, ...`` per jaxpr in order
+  of first appearance (invars, constvars, then eqn outputs).
+* **source info** — jaxprs carry file/line provenance; none of it is
+  serialized here, so moving a stepper ten lines down (or re-indenting
+  it) cannot churn the baseline.
+* **memory addresses** — params occasionally repr as
+  ``<function f at 0x7f...>``; every ``0x...`` token is scrubbed.
+* **the sparse cache salt** — ``ops/activity.py`` folds a per-process
+  net-zero constant into the traced sparse evolve (its persistent-cache
+  opt-out).  Any literal equal to the live salt serializes as ``SALT``
+  so the sparse cells fingerprint identically across processes.
+
+Constants/array literals serialize as ``dtype[shape]#sha1`` of their
+bytes — value-exact (a changed pad mask IS drift) without embedding
+megabytes into the canonical text.
+
+``canonicalize`` also collects the facts the checks need in the same
+walk: the set of primitive names reachable (purity check) and every
+``ppermute``'s axis/permutation/operand-shape (collective check).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+try:  # jax >= 0.4.16 keeps these under jax._src.core
+    from jax._src.core import ClosedJaxpr, Jaxpr, Literal
+except ImportError:  # pragma: no cover — jax internals moved
+    from jax.core import ClosedJaxpr, Jaxpr, Literal  # type: ignore
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+_SALT_TOKEN = "SALT"
+
+
+def _cache_salt() -> Optional[int]:
+    """The live per-process sparse-cache salt (None if the module is
+    unavailable — canonicalization must not hard-depend on it)."""
+    try:
+        from mpi_tpu.ops.activity import cache_salt
+        return cache_salt()
+    except Exception:  # pragma: no cover
+        return None
+
+
+@dataclass(frozen=True)
+class CollectiveRecord:
+    """One ``ppermute`` as seen in the trace: the named axis, the
+    (src, dst) permutation, and the exchanged operand's shape."""
+
+    axis_name: str
+    perm: Tuple[Tuple[int, int], ...]
+    shape: Tuple[int, ...]
+
+
+@dataclass
+class CanonResult:
+    text: str
+    fingerprint: str
+    prim_names: Set[str] = field(default_factory=set)
+    collectives: List[CollectiveRecord] = field(default_factory=list)
+
+
+def _is_subjaxpr(v) -> bool:
+    return isinstance(v, (ClosedJaxpr, Jaxpr))
+
+
+def _array_token(arr, salt) -> str:
+    a = np.asarray(arr)
+    if a.ndim == 0 and salt is not None and a.dtype.kind in "iu" \
+            and int(a) == salt:
+        return f"{a.dtype}[]={_SALT_TOKEN}"
+    if a.size <= 4:
+        return f"{a.dtype}{list(a.shape)}={a.tolist()!r}"
+    digest = hashlib.sha1(a.tobytes()).hexdigest()[:12]
+    return f"{a.dtype}{list(a.shape)}#{digest}"
+
+
+class _Canonicalizer:
+    def __init__(self):
+        self.salt = _cache_salt()
+        self.prim_names: Set[str] = set()
+        self.collectives: List[CollectiveRecord] = []
+
+    # -- values ----------------------------------------------------------
+
+    def _value(self, v, names: Dict[int, str]) -> str:
+        if isinstance(v, ClosedJaxpr):
+            consts = ",".join(_array_token(c, self.salt) if _is_arrayish(c)
+                              else self._value(c, {}) for c in v.consts)
+            return f"closed(consts=[{consts}]){self._jaxpr(v.jaxpr)}"
+        if isinstance(v, Jaxpr):
+            return self._jaxpr(v)
+        if isinstance(v, (list, tuple)):
+            inner = ",".join(self._value(w, names) for w in v)
+            return f"({inner})" if isinstance(v, tuple) else f"[{inner}]"
+        if isinstance(v, dict):
+            items = ",".join(
+                f"{k!r}:{self._value(v[k], names)}" for k in sorted(v, key=repr))
+            return "{" + items + "}"
+        if _is_arrayish(v):
+            return _array_token(v, self.salt)
+        if isinstance(v, (bool, int, float, complex, str, bytes,
+                          type(None))):
+            if isinstance(v, int) and self.salt is not None and v == self.salt:
+                return _SALT_TOKEN
+            return repr(v)
+        # meshes, shardings, dtypes, effect sets, callables: repr with
+        # memory addresses scrubbed (the rest of these reprs is stable)
+        return _ADDR_RE.sub("0xX", repr(v))
+
+    # -- atoms -----------------------------------------------------------
+
+    def _atom(self, a, names: Dict[int, str]) -> str:
+        if isinstance(a, Literal):
+            return f"lit({_array_token(a.val, self.salt)})"
+        key = id(a)
+        if key not in names:
+            names[key] = f"v{len(names)}"
+        return f"{names[key]}:{a.aval.str_short()}"
+
+    def _bind(self, a, names: Dict[int, str]) -> str:
+        # an output var; DropVar has no binding identity worth naming
+        if type(a).__name__ == "DropVar":
+            return "_"
+        return self._atom(a, names)
+
+    # -- jaxprs ----------------------------------------------------------
+
+    def _jaxpr(self, jx: Jaxpr, indent: int = 1) -> str:
+        names: Dict[int, str] = {}
+        pad = "  " * indent
+        head_in = " ".join(self._atom(v, names) for v in jx.invars)
+        head_const = " ".join(self._atom(v, names) for v in jx.constvars)
+        lines = [f"jaxpr(in=[{head_in}] const=[{head_const}])"]
+        for eq in jx.eqns:
+            prim = eq.primitive.name
+            self.prim_names.add(prim)
+            if prim == "ppermute":
+                self._record_ppermute(eq)
+            params = ",".join(
+                f"{k}={self._value(eq.params[k], names)}"
+                for k in sorted(eq.params))
+            outs = " ".join(self._bind(v, names) for v in eq.outvars)
+            ins = " ".join(self._atom(v, names) for v in eq.invars)
+            lines.append(f"{pad}{outs} = {prim}[{params}] {ins}")
+        ret = " ".join(self._atom(v, names) for v in jx.outvars)
+        lines.append(f"{pad}return {ret}")
+        return "\n".join(lines)
+
+    def _record_ppermute(self, eq) -> None:
+        ax = eq.params.get("axis_name")
+        if isinstance(ax, (tuple, list)):
+            ax = ax[0] if len(ax) == 1 else tuple(ax)
+        perm = tuple((int(s), int(d)) for s, d in eq.params.get("perm", ()))
+        shape = tuple(int(s) for s in eq.invars[0].aval.shape)
+        self.collectives.append(CollectiveRecord(str(ax), perm, shape))
+
+
+def _is_arrayish(v) -> bool:
+    return isinstance(v, np.ndarray) or np.isscalar(v) and not isinstance(
+        v, (str, bytes)) or type(v).__module__.startswith("jax") and hasattr(
+        v, "dtype") and hasattr(v, "shape")
+
+
+def canonicalize(closed: ClosedJaxpr) -> CanonResult:
+    """Canonical text + fingerprint of a ClosedJaxpr, plus the primitive
+    set and ppermute records the IR checks consume."""
+    c = _Canonicalizer()
+    consts = ",".join(_array_token(v, c.salt) if _is_arrayish(v)
+                      else c._value(v, {}) for v in closed.consts)
+    text = f"consts=[{consts}]\n{c._jaxpr(closed.jaxpr)}"
+    text = _ADDR_RE.sub("0xX", text)
+    fp = hashlib.sha1(text.encode("utf-8")).hexdigest()[:16]
+    return CanonResult(text=text, fingerprint=fp, prim_names=c.prim_names,
+                       collectives=c.collectives)

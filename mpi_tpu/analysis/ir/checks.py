@@ -1,0 +1,272 @@
+"""The IR-level invariant checks.
+
+Each check takes traced-cell facts and returns :class:`IRFinding`s.
+They are deliberately pure functions over :class:`~.harness.TracedCell`
+data (no tracing, no engines) so tests can seed violations — a
+fabricated non-bijective permutation, a tampered donating seam stepper,
+a signature function with a field dropped — and pin the exact
+diagnostics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from mpi_tpu.analysis.ir.harness import TracedCell
+from mpi_tpu.analysis.ir.matrix import Cell, near_pairs
+
+# primitives that must never be reachable from a production stepper:
+# host round-trips (callbacks), debug effects, and infeed/outfeed would
+# all stall or desync the serving hot path and break replay determinism
+IMPURE_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "host_callback", "outside_call", "infeed", "outfeed",
+})
+
+
+@dataclass(frozen=True)
+class IRFinding:
+    """One IR diagnostic: ``cell <id>: [<check>] message``."""
+
+    check: str
+    cell: str
+    message: str
+
+    def format(self) -> str:
+        return f"cell {self.cell}: [{self.check}] {self.message}"
+
+    def fingerprint(self) -> str:
+        raw = f"{self.check}:{self.cell}:{self.message}"
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+
+# -- donation-aliasing contracts ------------------------------------------
+
+def check_donation(tc: TracedCell) -> List[IRFinding]:
+    """Seam-stitched programs must carry NO input/output donation (the
+    band extraction reads the pre-step grid the base step would alias in
+    place — the PR-3 race); every other stepper must donate (losing the
+    donation silently doubles peak HBM per session)."""
+    out: List[IRFinding] = []
+    got = tc.donor_in_ir or tc.args_donated
+    if not tc.donates_expected and got:
+        how = []
+        if tc.donor_in_ir:
+            how.append("donor/aliasing markers in the lowered IR")
+        if tc.args_donated:
+            how.append("donated args in args_info")
+        out.append(IRFinding(
+            "ir-donation", tc.cell.id,
+            f"seam-stitched stepper lowered WITH input/output donation "
+            f"({', '.join(how)}): the seam band reads the pre-step grid "
+            f"— re-enabling donation here reintroduces the PR-3 "
+            f"donation race (nondeterministic shard corruption on "
+            f"multi-device meshes)"))
+    elif tc.donates_expected and not got:
+        out.append(IRFinding(
+            "ir-donation", tc.cell.id,
+            f"stepper expected to donate its input but the lowered IR "
+            f"carries no donor/aliasing marker "
+            f"({' / '.join(('jax.buffer_donor', 'tf.aliasing_output'))}): "
+            f"the donation was silently lost and every step pays a "
+            f"second grid buffer"))
+    return out
+
+
+# -- collective validity --------------------------------------------------
+
+def check_collectives(tc: TracedCell) -> List[IRFinding]:
+    """Every ``ppermute`` in the trace must be a valid (partial)
+    permutation of the named mesh axis — full ring on periodic
+    boundaries, injective chain on dead — and its operand slab must be
+    exactly one halo depth (rule radius x comm cadence, or the packed
+    engines' single ghost word column) thick."""
+    from mpi_tpu.parallel.halo import expected_slab_depths
+    from mpi_tpu.parallel.mesh import AXES
+
+    out: List[IRFinding] = []
+    axis_sizes = {AXES[0]: tc.engine.mi, AXES[1]: tc.engine.mj}
+    periodic = tc.config.boundary == "periodic"
+    allowed = expected_slab_depths(
+        tc.config.rule.radius, tc.config.comm_every, tc.engine.bitpacked)
+    for rec in tc.collectives:
+        n = axis_sizes.get(rec.axis_name)
+        if n is None:
+            out.append(IRFinding(
+                "ir-collective", tc.cell.id,
+                f"ppermute over unknown mesh axis {rec.axis_name!r} "
+                f"(mesh axes: {sorted(axis_sizes)})"))
+            continue
+        srcs = [s for s, _ in rec.perm]
+        dsts = [d for _, d in rec.perm]
+        bad_range = [p for p in srcs + dsts if not 0 <= p < n]
+        if bad_range:
+            out.append(IRFinding(
+                "ir-collective", tc.cell.id,
+                f"ppermute over axis {rec.axis_name!r} (size {n}) names "
+                f"out-of-range devices {sorted(set(bad_range))}: "
+                f"perm={rec.perm}"))
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            out.append(IRFinding(
+                "ir-collective", tc.cell.id,
+                f"ppermute permutation over axis {rec.axis_name!r} is "
+                f"not a bijection: duplicate "
+                f"{'source' if len(set(srcs)) != len(srcs) else 'destination'}"
+                f" in perm={rec.perm} (a device would receive two halo "
+                f"slabs, or its ghost ring garbage)"))
+        elif periodic and len(rec.perm) != n:
+            out.append(IRFinding(
+                "ir-collective", tc.cell.id,
+                f"periodic stepper's ppermute closes only "
+                f"{len(rec.perm)} of {n} ring links over axis "
+                f"{rec.axis_name!r} (perm={rec.perm}): an edge shard's "
+                f"ghosts would arrive as zeros — dead-boundary "
+                f"semantics on a periodic run"))
+        thin = min(rec.shape) if rec.shape else 0
+        if thin not in allowed:
+            out.append(IRFinding(
+                "ir-collective", tc.cell.id,
+                f"halo slab shape {rec.shape} over axis "
+                f"{rec.axis_name!r} has depth {thin}, expected one of "
+                f"{sorted(allowed)} (rule radius "
+                f"{tc.config.rule.radius} x comm_every "
+                f"{tc.config.comm_every}"
+                f"{', or one ghost word column' if tc.engine.bitpacked else ''})"))
+    return out
+
+
+# -- IR purity ------------------------------------------------------------
+
+def check_purity(tc: TracedCell) -> List[IRFinding]:
+    """No callback/debug/io primitives reachable in a production
+    stepper's trace (complements the AST ``traced-purity`` rule, which
+    sees syntax — this sees what actually got traced)."""
+    return [
+        IRFinding(
+            "ir-purity", tc.cell.id,
+            f"traced stepper reaches impure primitive '{p}': host "
+            f"round-trips in the hot loop stall the device pipeline and "
+            f"break checkpoint-replay determinism")
+        for p in sorted(tc.prim_names & IMPURE_PRIMITIVES)
+    ]
+
+
+# -- plan_signature soundness ---------------------------------------------
+
+SignatureFn = Callable[[object, Tuple[int, int]], tuple]
+
+
+def check_signatures(traced: Sequence[TracedCell],
+                     signature_fn: Optional[SignatureFn] = None
+                     ) -> List[IRFinding]:
+    """Both directions of the EngineCache keying contract.
+
+    Soundness: cells agreeing in (signature, depth, batch) must trace to
+    identical canonical jaxprs — a collision means ``EngineCache`` would
+    silently serve one config the other's compiled executable.
+
+    Completeness (via the matrix annotations): ``twin_of`` pairs differ
+    only in signature-EXCLUDED fields, so their signatures must collide
+    (cache sharing is the point) and their traces must match;
+    ``NEAR_PAIRS`` differ in exactly one signature-visible field, so
+    their signatures must differ — and when depth/batch agree, so must
+    their fingerprints (else the pair stopped exercising the field).
+    """
+    out: List[IRFinding] = []
+    if signature_fn is not None:
+        def key_of(tc: TracedCell) -> tuple:
+            return (signature_fn(tc.config, (tc.engine.mi, tc.engine.mj)),
+                    tc.cell.depth, tc.cell.batch)
+    else:
+        def key_of(tc: TracedCell) -> tuple:
+            return tc.group_key
+
+    groups: Dict[tuple, List[TracedCell]] = {}
+    for tc in traced:
+        groups.setdefault(key_of(tc), []).append(tc)
+    for key, members in groups.items():
+        fps = {m.fingerprint for m in members}
+        if len(fps) > 1:
+            ids = ", ".join(sorted(m.cell.id for m in members))
+            out.append(IRFinding(
+                "ir-signature", sorted(m.cell.id for m in members)[0],
+                f"plan_signature collision: cells {ids} share a plan "
+                f"signature (at depth {key[1]}, B={key[2]}) but trace "
+                f"to different canonical jaxprs (fingerprints "
+                f"{sorted(fps)}): EngineCache would return the wrong "
+                f"compiled executable for one of them"))
+
+    by_id = {tc.cell.id: tc for tc in traced}
+    for tc in traced:
+        twin = by_id.get(tc.cell.twin_of) if tc.cell.twin_of else None
+        if twin is None:
+            continue
+        if key_of(tc)[0] != key_of(twin)[0]:
+            out.append(IRFinding(
+                "ir-signature", tc.cell.id,
+                f"cells {tc.cell.id} and {twin.cell.id} differ only in "
+                f"signature-excluded fields (seed) but get distinct "
+                f"plan signatures: engine sharing across sessions "
+                f"regressed"))
+        elif tc.fingerprint != twin.fingerprint:
+            out.append(IRFinding(
+                "ir-signature", tc.cell.id,
+                f"seed-only twins {tc.cell.id} and {twin.cell.id} trace "
+                f"to different canonical jaxprs ({tc.fingerprint} != "
+                f"{twin.fingerprint}): either the seed leaked into the "
+                f"traced program or canonicalization is unstable"))
+
+    cells = [tc.cell for tc in traced]
+    for a, b, fld in near_pairs(cells):
+        ta, tb = by_id[a.id], by_id[b.id]
+        if key_of(ta)[0] == key_of(tb)[0]:
+            out.append(IRFinding(
+                "ir-signature", a.id,
+                f"plan_signature is blind to field '{fld}': cells "
+                f"{a.id} and {b.id} differ in it but share a signature "
+                f"— two different programs would hit one EngineCache "
+                f"entry"))
+        elif (a.depth, a.batch) == (b.depth, b.batch) \
+                and ta.fingerprint == tb.fingerprint:
+            out.append(IRFinding(
+                "ir-signature", a.id,
+                f"near-collision pair {a.id}/{b.id} (field '{fld}') "
+                f"traced to identical jaxprs: the matrix pair is inert "
+                f"and no longer exercises the field"))
+    return out
+
+
+# -- IR drift baselines ---------------------------------------------------
+
+def check_drift(traced: Sequence[TracedCell], baseline: Dict[str, dict],
+                complete: bool = False) -> List[IRFinding]:
+    """Compare each cell's canonical fingerprint to the checked-in
+    baseline.  ``complete=True`` (a full-matrix run) also flags stale
+    baseline entries whose cell no longer exists."""
+    out: List[IRFinding] = []
+    for tc in traced:
+        rec = baseline.get(tc.cell.id)
+        if rec is None:
+            out.append(IRFinding(
+                "ir-drift", tc.cell.id,
+                f"no IR baseline recorded for this cell (bless with "
+                f"`python -m mpi_tpu.analysis.ir --write-baseline`)"))
+        elif rec.get("fingerprint") != tc.fingerprint:
+            out.append(IRFinding(
+                "ir-drift", tc.cell.id,
+                f"stepper trace drifted: canonical jaxpr fingerprint "
+                f"{tc.fingerprint} != baselined "
+                f"{rec.get('fingerprint')} — if the change is "
+                f"intentional, bless it with `python -m "
+                f"mpi_tpu.analysis.ir --write-baseline` (and say why in "
+                f"the commit)"))
+    if complete:
+        live = {tc.cell.id for tc in traced}
+        for stale in sorted(set(baseline) - live):
+            out.append(IRFinding(
+                "ir-drift", stale,
+                f"baseline entry for unknown cell '{stale}' (removed "
+                f"from the matrix?) — regenerate with --write-baseline"))
+    return out

@@ -1,0 +1,143 @@
+"""Abstract tracing harness: matrix cell -> TracedCell facts.
+
+Builds the real engine (``backends.tpu.build_engine`` — the exact
+dispatch the serve stack uses), then extracts the verifier's facts with
+NO device execution beyond the tiny ``init_grid`` placement:
+
+* ``jax.make_jaxpr`` over the engine's evolve at the cell's depth — the
+  canonical jaxpr, primitive set, and ppermute records (via
+  :mod:`.canon`);
+* ``evolve.lower(...)`` — the StableHLO text whose donor/aliasing
+  markers say whether XLA was *actually* offered the input buffer
+  (``args_info`` says what jit requested; the IR markers say what got
+  lowered — the PR-3 class lives in the gap between intent and IR);
+* ``plan_signature`` — the EngineCache key the soundness check judges.
+
+The dispatch is pinned to the CPU/XLA path (``MPI_TPU_PALLAS_INTERPRET``
+forced off for the duration) so fingerprints cannot depend on ambient
+test-environment flags.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Set
+
+import jax
+
+from mpi_tpu.analysis.ir.canon import CanonResult, CollectiveRecord, canonicalize
+from mpi_tpu.analysis.ir.matrix import Cell
+from mpi_tpu.config import GolConfig, plan_signature
+
+# markers jax 0.4.x lowers donated/aliased buffers with (which one
+# appears depends on program structure; either means XLA got the buffer)
+DONOR_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
+
+
+class HarnessError(RuntimeError):
+    """A cell could not be traced (missing devices, engine build failed)
+    — surfaced as a runner internal error (exit 2), never a silent pass."""
+
+
+@dataclass
+class TracedCell:
+    """Everything the checks consume about one traced cell."""
+
+    cell: Cell
+    config: GolConfig
+    engine: object
+    signature: tuple
+    canon: CanonResult
+    donates_expected: bool
+    donor_in_ir: bool
+    args_donated: bool
+
+    @property
+    def fingerprint(self) -> str:
+        return self.canon.fingerprint
+
+    @property
+    def prim_names(self) -> Set[str]:
+        return self.canon.prim_names
+
+    @property
+    def collectives(self) -> List[CollectiveRecord]:
+        return self.canon.collectives
+
+    @property
+    def group_key(self) -> tuple:
+        """The executable-collision unit: the serve layer memoizes one
+        engine per signature and one executable per (depth, B) inside
+        it, so two traces may only be required to agree when signature,
+        depth AND batch width all match."""
+        return (self.signature, self.cell.depth, self.cell.batch)
+
+
+@contextlib.contextmanager
+def _pinned_dispatch():
+    """Pin the engine dispatch to the plain XLA path for the trace:
+    interpret-mode Pallas (a test-only env escape hatch) must not leak
+    into baseline fingerprints."""
+    old = os.environ.get("MPI_TPU_PALLAS_INTERPRET")
+    os.environ["MPI_TPU_PALLAS_INTERPRET"] = "0"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("MPI_TPU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["MPI_TPU_PALLAS_INTERPRET"] = old
+
+
+def trace_engine(cell: Cell, engine, evolve, grid) -> TracedCell:
+    """The fact-extraction half of :func:`trace_cell`, split out so
+    tests can seed a *tampered* evolve (e.g. a donation re-enable on a
+    seam engine) against the real engine contract."""
+    closed = jax.make_jaxpr(lambda g: evolve(g, cell.depth))(grid)
+    canon = canonicalize(closed)
+    lowered = evolve.lower(grid, cell.depth)
+    text = lowered.as_text()
+    donor_in_ir = any(m in text for m in DONOR_MARKERS)
+    args_donated = any(
+        bool(getattr(a, "donated", False))
+        for a in jax.tree_util.tree_leaves(lowered.args_info))
+    mi, mj = engine.mi, engine.mj
+    return TracedCell(
+        cell=cell, config=engine.config, engine=engine,
+        signature=plan_signature(engine.config, (mi, mj)),
+        canon=canon,
+        donates_expected=engine.donates_input,
+        donor_in_ir=donor_in_ir, args_donated=args_donated,
+    )
+
+
+def trace_cell(cell: Cell) -> TracedCell:
+    """Build the cell's engine and trace its stepper abstractly."""
+    if cell.devices_needed > len(jax.devices()):
+        raise HarnessError(
+            f"cell {cell.id}: mesh {cell.mesh} needs {cell.devices_needed} "
+            f"devices, have {len(jax.devices())} (run via "
+            f"`python -m mpi_tpu.analysis.ir`, which forces the virtual "
+            f"CPU mesh)")
+    with _pinned_dispatch():
+        from mpi_tpu.backends.tpu import build_engine
+
+        try:
+            config = cell.make_config()
+            engine = build_engine(config)
+            if cell.batch > 0:
+                boards = [engine.init_grid(seed=cell.seed + i)
+                          for i in range(cell.batch)]
+                grid = engine.stack_grids(boards)
+                evolve = engine._get_batched_evolve()
+            else:
+                grid = engine.init_grid()
+                evolve = engine._evolve
+            return trace_engine(cell, engine, evolve, grid)
+        except HarnessError:
+            raise
+        except Exception as e:
+            raise HarnessError(
+                f"cell {cell.id}: {type(e).__name__}: {e}") from e

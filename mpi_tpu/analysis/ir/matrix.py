@@ -1,0 +1,143 @@
+"""The config matrix the IR verifier traces.
+
+Each :class:`Cell` pins one (engine family x boundary x mesh x
+dense/sparse x solo/batched x depth) point; the harness builds the
+engine off-device, traces its stepper abstractly, and the checks judge
+the resulting jaxpr/lowering.  The ``fast`` tier is the tier-1 subset
+(every engine family once, a few seconds total on the 1-core CPU box);
+the ``full`` tier is the default for ``python -m mpi_tpu.analysis.ir``
+and the checked-in drift baseline covers it.
+
+Cells are traced on the **CPU dispatch path** (``JAX_PLATFORMS=cpu``,
+Pallas interpret pinned off) — the path the serve stack actually
+compiles on this box, and the only one whose fingerprints are
+reproducible everywhere the gate runs.
+
+``TWINS`` are cell pairs that differ only in a field ``plan_signature``
+deliberately EXCLUDES (seed): their signatures must collide and their
+traces must be identical — the cache-sharing contract, and a canary for
+canonicalization instability.  ``NEAR_PAIRS`` differ in exactly one
+field the signature must SEE: their signatures must differ (a signature
+blind to the field would hand one config the other's executable), and —
+when depth/batch agree — so must their canonical jaxprs (an inert pair
+would mean the matrix stopped exercising that field).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from mpi_tpu.config import GolConfig
+from mpi_tpu.models.rules import rule_from_name
+
+
+@dataclass(frozen=True)
+class Cell:
+    """One matrix point.  ``batch`` = 0 traces the solo stepper;
+    B > 0 traces the vmapped ``[B, ...]`` batched stepper.  ``depth``
+    is the static step count handed to the traced evolve."""
+
+    id: str
+    rows: int
+    cols: int
+    rule: str = "life"
+    boundary: str = "periodic"
+    mesh: Tuple[int, int] = (1, 1)
+    comm_every: int = 1
+    sparse_tile: int = 0
+    overlap: bool = False
+    depth: int = 1
+    batch: int = 0
+    seed: int = 0
+    tier: str = "full"            # "fast" cells also run in tier-1
+    twin_of: Optional[str] = None  # seed-only twin (signature must match)
+
+    def make_config(self) -> GolConfig:
+        return GolConfig(
+            rows=self.rows, cols=self.cols, steps=0, seed=self.seed,
+            rule=rule_from_name(self.rule), boundary=self.boundary,
+            backend="tpu", mesh_shape=self.mesh,
+            comm_every=self.comm_every, overlap=self.overlap,
+            sparse_tile=self.sparse_tile,
+        )
+
+    @property
+    def devices_needed(self) -> int:
+        return self.mesh[0] * self.mesh[1]
+
+
+# a radius-2 Larger-than-Life rule (the bit-sliced engine's bread and
+# butter); bosco (radius 5) lands on the dense stencil engine off-TPU
+_R2 = "R2,B8-12,S9-14"
+
+CELLS: List[Cell] = [
+    # -- fast tier: every engine family once ----------------------------
+    Cell("packed_1x1", 64, 64, depth=2, tier="fast"),
+    Cell("packed_1x1_seed7", 64, 64, depth=2, seed=7, tier="fast",
+         twin_of="packed_1x1"),
+    Cell("packed_1x2_periodic", 64, 64, mesh=(1, 2), depth=2, tier="fast"),
+    Cell("packed_1x2_dead", 64, 64, boundary="dead", mesh=(1, 2), depth=2,
+         tier="fast"),
+    Cell("packed_2x2_dead", 64, 64, boundary="dead", mesh=(2, 2), depth=2,
+         tier="fast"),
+    Cell("packed_k2_1x2", 64, 64, mesh=(1, 2), comm_every=2, depth=3,
+         tier="fast"),
+    Cell("seam_1x1", 64, 48, depth=2, tier="fast"),
+    Cell("ltl_r2_1x2_dead", 64, 64, rule=_R2, boundary="dead", mesh=(1, 2),
+         depth=1, tier="fast"),
+    Cell("dense_bosco_1x1", 64, 64, rule="bosco", depth=1, tier="fast"),
+    Cell("sparse_1x1", 64, 64, sparse_tile=32, depth=2, tier="fast"),
+    Cell("batched_packed_1x2", 64, 64, mesh=(1, 2), depth=2, batch=2,
+         tier="fast"),
+    Cell("batched_seam_1x1", 64, 48, depth=2, batch=2, tier="fast"),
+    # -- full tier: the wider sweep -------------------------------------
+    Cell("packed_2x2_periodic", 64, 64, mesh=(2, 2), depth=2),
+    Cell("packed_2x1_asym", 128, 64, mesh=(2, 1), depth=1),
+    Cell("packed_k4_1x2", 64, 64, mesh=(1, 2), comm_every=4, depth=5),
+    Cell("packed_w128_1x2", 64, 128, mesh=(1, 2), depth=2),
+    Cell("packed_w128_overlap_1x2", 64, 128, mesh=(1, 2), overlap=True,
+         depth=2),
+    Cell("highlife_1x2", 64, 64, rule="highlife", mesh=(1, 2), depth=2),
+    Cell("seam_1x2", 64, 80, mesh=(1, 2), depth=2),
+    Cell("ltl_r2_2x2_periodic", 64, 64, rule=_R2, mesh=(2, 2), depth=2),
+    Cell("dense_bosco_1x1_dead", 64, 64, rule="bosco", boundary="dead",
+         depth=1),
+    Cell("sparse_ltl_1x1", 64, 64, rule=_R2, sparse_tile=32, depth=1),
+    Cell("batched_sparse_1x1", 64, 64, sparse_tile=32, depth=1, batch=2),
+]
+
+# (cell_a, cell_b, the one signature-visible field they differ in)
+NEAR_PAIRS: List[Tuple[str, str, str]] = [
+    ("packed_1x2_periodic", "packed_1x2_dead", "boundary"),
+    ("packed_1x1", "packed_1x2_periodic", "mesh_shape"),
+    ("packed_1x1", "sparse_1x1", "sparse_tile"),
+    ("packed_1x2_periodic", "packed_k2_1x2", "comm_every"),
+    ("packed_1x2_periodic", "highlife_1x2", "rule"),
+    ("packed_2x2_dead", "packed_2x2_periodic", "boundary"),
+    ("packed_w128_1x2", "packed_w128_overlap_1x2", "overlap"),
+]
+
+_BY_ID = {c.id: c for c in CELLS}
+assert len(_BY_ID) == len(CELLS), "duplicate cell ids"
+
+
+def cell_by_id(cell_id: str) -> Cell:
+    try:
+        return _BY_ID[cell_id]
+    except KeyError:
+        raise KeyError(f"unknown matrix cell {cell_id!r} "
+                       f"(see --list-cells)") from None
+
+
+def cells(fast_only: bool = False) -> List[Cell]:
+    if fast_only:
+        return [c for c in CELLS if c.tier == "fast"]
+    return list(CELLS)
+
+
+def near_pairs(selected: List[Cell]) -> List[Tuple[Cell, Cell, str]]:
+    """The NEAR_PAIRS whose both endpoints are in ``selected``."""
+    ids = {c.id for c in selected}
+    return [(_BY_ID[a], _BY_ID[b], f)
+            for a, b, f in NEAR_PAIRS if a in ids and b in ids]

@@ -447,6 +447,19 @@ class Engine:
         """Real grid width of a padded run (None when nothing is padded)."""
         return self.config.cols if self.pad_bits else None
 
+    @property
+    def donates_input(self) -> bool:
+        """Whether this engine's steppers donate their input grid.
+
+        Seam-stitched programs (padded periodic, see make_seam_stepper)
+        must NOT donate: the band extraction reads the pre-step grid the
+        base step would alias in place, which races on multi-device
+        meshes.  Everything else must donate — losing it silently doubles
+        peak HBM per session.  The IR verifier
+        (``python -m mpi_tpu.analysis.ir``) holds the lowered IR to this
+        contract in both directions."""
+        return not (self.pad_bits > 0 and self.config.boundary == "periodic")
+
     def init_grid(self, initial=None, seed=None):
         """A fresh device-resident grid on this engine's mesh/sharding.
         ``seed`` overrides config.seed: serve sessions share one engine
@@ -591,8 +604,7 @@ class Engine:
             # band extraction reads the pre-step grid the base step would
             # alias in place, which races on multi-device meshes (see
             # make_seam_stepper) — the hazard vmaps along with the body
-            seam = self.pad_bits > 0 and self.config.boundary == "periodic"
-            jit_kwargs = {} if seam else {"donate_argnums": 0}
+            jit_kwargs = {"donate_argnums": 0} if self.donates_input else {}
 
             @functools.partial(jax.jit, static_argnames=("steps",),
                                **jit_kwargs)

@@ -178,13 +178,31 @@ def plan_signature(config: GolConfig, mesh_shape: Tuple[int, int],
     as the sorted distinct ``segments`` set).  ``mesh_shape`` is the
     RESOLVED shape (auto-chosen meshes must not alias an explicit one of
     a different shape), and ``Rule`` is a frozen dataclass of frozensets,
-    so the whole tuple hashes."""
+    so the whole tuple hashes.
+
+    The IR verifier (``python -m mpi_tpu.analysis.ir``) gates this key
+    in BOTH directions over its config matrix: equal signatures must
+    trace to identical canonical jaxprs, and matrix near-pairs differing
+    in one signature-visible field must get distinct signatures.  Adding
+    a config field that reaches the traced program means adding it here
+    AND to ``SIGNATURE_FIELDS`` AND regenerating the IR baseline — see
+    MIGRATION.md."""
     return (
         config.rows, config.cols, config.rule, config.boundary,
         config.backend, tuple(mesh_shape), config.comm_every,
         bool(config.overlap), tuple(sorted(set(segments))),
         config.sparse_tile,
     )
+
+
+# what each position of the plan_signature tuple holds, in order — a
+# documented arity contract so the IR verifier's tests fail loudly when
+# someone extends the signature without updating the field list (or vice
+# versa) instead of silently shifting positions
+SIGNATURE_FIELDS = (
+    "rows", "cols", "rule", "boundary", "backend", "mesh_shape",
+    "comm_every", "overlap", "segments", "sparse_tile",
+)
 
 
 def plan_segments(steps: int, snapshot_every: int) -> List[int]:

@@ -133,6 +133,13 @@ _CACHE_SALT: int = (
     os.getpid() ^ int.from_bytes(os.urandom(4), "little")) & 0x7FFFFFFF
 
 
+def cache_salt() -> int:
+    """The live per-process cache salt.  The IR verifier's canonicalizer
+    (analysis/ir/canon.py) scrubs literals equal to this value so sparse
+    stepper fingerprints stay stable across processes."""
+    return _CACHE_SALT
+
+
 def _no_persistent_cache_write():
     """Context manager raising the persistent cache's min-compile-time
     write threshold so the enclosed compile is never serialized; no-op

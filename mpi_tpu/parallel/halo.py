@@ -59,6 +59,24 @@ def _axis_exchange(x, axis_name: str, spatial_axis: int, radius: int, periodic: 
     return jnp.concatenate([before, x, after], axis=spatial_axis)
 
 
+def expected_slab_depths(radius: int, comm_every: int, packed: bool):
+    """The legal thin-extents of a halo slab exchanged by one ppermute.
+
+    A stepper that communicates every k-th step (k ≤ comm_every, since
+    segment tails exchange at their own shorter cadence) ships a
+    ``k * radius``-deep slab; bitpacked engines additionally exchange a
+    single ghost *word* column (depth 1 — 32 halo bits cover any
+    K·r ≤ 31, see exchange_halo_rc).  This is the single source of truth
+    the IR verifier's collective check
+    (``python -m mpi_tpu.analysis.ir``) holds traced slab shapes to —
+    widen it if the exchange protocol legitimately changes.
+    """
+    depths = {k * radius for k in range(1, comm_every + 1)}
+    if packed:
+        depths.add(1)
+    return depths
+
+
 def exchange_halo(local, radius: int, boundary: str, axes=AXES):
     """(h, w) shard → (h+2r, w+2r) with ghost ring filled.  Must be called
     inside ``shard_map`` over a mesh with the given axis names.  Rows phase

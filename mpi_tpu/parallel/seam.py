@@ -146,5 +146,8 @@ def make_seam_stepper(inner, rule: Rule, C: int, K: int):
     # shard's input word clobbered while the band slice still reads it;
     # observed as nondeterministic whole-shard corruption on the
     # 8-virtual-device CPU mesh).  Seam runs pay one extra grid buffer;
-    # the un-wrapped steppers keep their donation.
+    # the un-wrapped steppers keep their donation.  The IR verifier
+    # (python -m mpi_tpu.analysis.ir, ir-donation check) holds the
+    # lowered IR to this in both directions: re-enabling donation here
+    # fails the gate and tests/test_ir_verify.py.
     return segmented_evolve(make_local, K, donate=False)

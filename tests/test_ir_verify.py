@@ -1,0 +1,348 @@
+"""Tier-1 gate for the ``mpi_tpu.analysis.ir`` jaxpr-level verifier.
+
+Layers, mirroring tests/test_lint.py:
+
+* the fast matrix itself — ``run_ir(fast_only=True)`` over the real
+  engines must be clean against the checked-in baseline, inside the
+  tier-1 budget (the full matrix runs in the unfiltered suite);
+* the PR-3 contract pinned at the IR layer — seam-stitched traces carry
+  no donation aliasing, every other stepper's does, and a *seeded*
+  donation re-enable / signature blinding is detected with the exact
+  diagnostic;
+* canonicalization stability — line-number/retrace invariance, no
+  memory addresses or absolute paths in the canonical text, the sparse
+  cache salt scrubbed;
+* check mechanics over fabricated facts — collective and purity
+  diagnostics fire without needing a broken engine;
+* baseline round-trip and the CLI exit-code contract.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_tpu.analysis.ir import load_baseline, run_ir, write_baseline
+from mpi_tpu.analysis.ir import checks
+from mpi_tpu.analysis.ir.canon import CanonResult, CollectiveRecord, canonicalize
+from mpi_tpu.analysis.ir.harness import TracedCell, trace_cell, trace_engine
+from mpi_tpu.analysis.ir.matrix import CELLS, NEAR_PAIRS, cell_by_id
+from mpi_tpu.config import SIGNATURE_FIELDS, plan_signature
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fast_report():
+    """One fast-matrix run shared by every test that only reads facts."""
+    t0 = time.perf_counter()
+    rep = run_ir(fast_only=True)
+    rep.elapsed = time.perf_counter() - t0
+    return rep
+
+
+# -- the real tree --------------------------------------------------------
+
+def test_fast_matrix_clean_and_fast(fast_report):
+    assert not fast_report.errors, fast_report.errors
+    assert not fast_report.findings, "\n".join(
+        f.format() for f in fast_report.findings)
+    # tier-1 budget on the 1-core CPU box (ISSUE 9 acceptance: ~30 s)
+    assert fast_report.elapsed < 30.0, (
+        f"fast IR matrix took {fast_report.elapsed:.1f}s")
+
+
+def test_full_matrix_clean():
+    # the complete matrix + drift vs the checked-in baseline, as CI runs
+    # it (slow-listed: excluded from tier-1, runs in the full suite)
+    rep = run_ir()
+    assert not rep.errors, rep.errors
+    assert not rep.findings, "\n".join(f.format() for f in rep.findings)
+    assert len(rep.traced) == len(CELLS)
+
+
+# -- the PR-3 contract at the IR layer ------------------------------------
+
+def test_seam_traces_carry_no_donation(fast_report):
+    """Regression pin: every seam-stitched cell lowers WITHOUT aliasing,
+    every other cell WITH — read off the IR, not the source."""
+    by_id = {tc.cell.id: tc for tc in fast_report.traced}
+    seam_ids = {"seam_1x1", "batched_seam_1x1"}
+    assert seam_ids <= set(by_id)
+    for tc in by_id.values():
+        if tc.cell.id in seam_ids:
+            assert not tc.donates_expected
+            assert not tc.donor_in_ir and not tc.args_donated, (
+                f"{tc.cell.id}: seam stepper lowered with donation — "
+                f"the PR-3 race is back")
+        else:
+            assert tc.donates_expected
+            assert tc.donor_in_ir, (
+                f"{tc.cell.id}: donation lost from the lowered IR")
+
+
+def test_seeded_seam_donation_reenable_detected():
+    """Tamper a seam engine's stepper with donate_argnums and the
+    donation check must fire with the PR-3 diagnostic."""
+    cell = cell_by_id("seam_1x1")
+    engine_mod = pytest.importorskip("mpi_tpu.backends.tpu")
+    engine = engine_mod.build_engine(cell.make_config())
+    base = engine._evolve
+    tampered = jax.jit(lambda g, steps: base(g, steps),
+                       static_argnames=("steps",), donate_argnums=0)
+    tc = trace_engine(cell, engine, tampered, engine.init_grid())
+    assert not tc.donates_expected      # the engine contract is intact
+    assert tc.donor_in_ir or tc.args_donated   # ...but the IR donates
+    findings = checks.check_donation(tc)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.check == "ir-donation" and f.cell == "seam_1x1"
+    assert "seam-stitched stepper lowered WITH input/output donation" \
+        in f.message
+    assert "PR-3" in f.message
+
+
+def test_donation_lost_detected():
+    """The inverse direction: a non-seam stepper whose donation went
+    missing is a finding too (silent 2x peak HBM)."""
+    real = trace_cell(cell_by_id("packed_1x1"))
+    stripped = TracedCell(
+        cell=real.cell, config=real.config, engine=real.engine,
+        signature=real.signature, canon=real.canon,
+        donates_expected=True, donor_in_ir=False, args_donated=False)
+    findings = checks.check_donation(stripped)
+    assert len(findings) == 1
+    assert "no donor/aliasing marker" in findings[0].message
+    # and the real trace is clean
+    assert checks.check_donation(real) == []
+
+
+# -- plan_signature soundness ---------------------------------------------
+
+def test_signature_fields_arity():
+    cfg = cell_by_id("packed_1x1").make_config()
+    sig = plan_signature(cfg, (1, 1))
+    assert len(sig) == len(SIGNATURE_FIELDS), (
+        "plan_signature grew/shrank without updating SIGNATURE_FIELDS "
+        "(and MIGRATION.md says: regenerate the IR baseline too)")
+
+
+def test_seeded_signature_collision_detected():
+    """Blind the signature to `boundary` and the soundness check must
+    report both the resulting collision and the blinded near-pair."""
+    i = SIGNATURE_FIELDS.index("boundary")
+
+    def blinded(config, mesh_shape):
+        sig = plan_signature(config, mesh_shape)
+        return sig[:i] + ("<dropped>",) + sig[i + 1:]
+
+    rep = run_ir(cell_ids=["packed_1x2_periodic", "packed_1x2_dead"],
+                 use_baseline=False, signature_fn=blinded)
+    assert not rep.errors, rep.errors
+    msgs = [f.message for f in rep.findings if f.check == "ir-signature"]
+    assert any("plan_signature collision" in m
+               and "packed_1x2_dead" in m and "packed_1x2_periodic" in m
+               and "EngineCache would return the wrong compiled executable"
+               in m for m in msgs), msgs
+    assert any("plan_signature is blind to field 'boundary'" in m
+               for m in msgs), msgs
+
+
+def test_signature_soundness_clean_on_real_engines(fast_report):
+    assert checks.check_signatures(fast_report.traced) == []
+
+
+def test_seed_twin_shares_signature_and_trace(fast_report):
+    by_id = {tc.cell.id: tc for tc in fast_report.traced}
+    a, b = by_id["packed_1x1"], by_id["packed_1x1_seed7"]
+    assert a.signature == b.signature
+    assert a.fingerprint == b.fingerprint
+
+
+def test_near_pairs_differ(fast_report):
+    by_id = {tc.cell.id: tc for tc in fast_report.traced}
+    for ida, idb, fld in NEAR_PAIRS:
+        if ida in by_id and idb in by_id:
+            assert by_id[ida].signature != by_id[idb].signature, fld
+
+
+# -- canonicalization stability -------------------------------------------
+
+def _fingerprint_of(fn, x):
+    return canonicalize(jax.make_jaxpr(fn)(x)).fingerprint
+
+
+def test_canon_is_line_number_invariant():
+    src = "def f(x):\n    return (x * 2 + 1).sum()\n"
+    ns1, ns2 = {}, {}
+    exec(compile(src, "variant_a.py", "exec"), ns1)
+    exec(compile("\n" * 57 + src, "/some/other/path/variant_b.py", "exec"),
+         ns2)
+    x = jnp.ones((8, 8), jnp.int32)
+    assert _fingerprint_of(ns1["f"], x) == _fingerprint_of(ns2["f"], x)
+
+
+def test_canon_is_retrace_invariant():
+    # fresh Var objects every trace; the rename must absorb them
+    def f(x):
+        return jnp.roll(x, 1, 0) + jnp.roll(x, -1, 0)
+
+    x = jnp.ones((8, 8), jnp.uint32)
+    assert _fingerprint_of(f, x) == _fingerprint_of(f, x)
+
+
+def test_canon_text_has_no_addresses_or_paths(fast_report):
+    for tc in fast_report.traced:
+        text = tc.canon.text
+        assert not re.search(r"0x[0-9a-fA-F]+", text), tc.cell.id
+        assert ROOT not in text, tc.cell.id
+
+
+def test_sparse_salt_scrubbed(fast_report):
+    from mpi_tpu.ops.activity import cache_salt
+
+    by_id = {tc.cell.id: tc for tc in fast_report.traced}
+    text = by_id["sparse_1x1"].canon.text
+    assert "SALT" in text
+    assert f"={cache_salt()!r}" not in text
+
+
+# -- check mechanics over fabricated facts --------------------------------
+
+def _fake_traced(cell_id="packed_1x2_periodic", *, collectives=(),
+                 prim_names=(), mesh=(1, 2), packed=True):
+    cell = cell_by_id(cell_id)
+    config = cell.make_config()
+    canon = CanonResult(text="", fingerprint="f" * 16,
+                        prim_names=set(prim_names),
+                        collectives=list(collectives))
+    engine = SimpleNamespace(mi=mesh[0], mj=mesh[1], bitpacked=packed,
+                             config=config)
+    return TracedCell(
+        cell=cell, config=config, engine=engine,
+        signature=plan_signature(config, mesh), canon=canon,
+        donates_expected=True, donor_in_ir=True, args_donated=True)
+
+
+def test_collective_non_bijection_detected():
+    from mpi_tpu.parallel.mesh import AXES
+
+    rec = CollectiveRecord(AXES[1], ((0, 1), (1, 1)), (64, 1))
+    msgs = [f.message for f in
+            checks.check_collectives(_fake_traced(collectives=[rec]))]
+    assert any("not a bijection" in m and "duplicate destination" in m
+               for m in msgs), msgs
+
+
+def test_collective_open_ring_on_periodic_detected():
+    from mpi_tpu.parallel.mesh import AXES
+
+    rec = CollectiveRecord(AXES[1], ((0, 1),), (64, 1))
+    msgs = [f.message for f in
+            checks.check_collectives(_fake_traced(collectives=[rec]))]
+    assert any("closes only 1 of 2 ring links" in m for m in msgs), msgs
+
+
+def test_collective_wrong_slab_depth_detected():
+    from mpi_tpu.parallel.mesh import AXES
+
+    # radius-1, comm_every=1, packed: legal depths are {1}; ship 3
+    rec = CollectiveRecord(AXES[0], ((0, 1), (1, 0)), (3, 64))
+    msgs = [f.message for f in
+            checks.check_collectives(_fake_traced(collectives=[rec]))]
+    assert any("has depth 3, expected one of [1]" in m for m in msgs), msgs
+
+
+def test_collective_unknown_axis_detected():
+    rec = CollectiveRecord("bogus_axis", ((0, 1), (1, 0)), (1, 64))
+    msgs = [f.message for f in
+            checks.check_collectives(_fake_traced(collectives=[rec]))]
+    assert any("unknown mesh axis 'bogus_axis'" in m for m in msgs), msgs
+
+
+def test_purity_violation_detected():
+    msgs = [f.message for f in checks.check_purity(
+        _fake_traced(prim_names={"debug_callback", "add"}))]
+    assert len(msgs) == 1 and "debug_callback" in msgs[0]
+
+
+def test_expected_slab_depths():
+    from mpi_tpu.parallel.halo import expected_slab_depths
+
+    assert expected_slab_depths(1, 1, False) == {1}
+    assert expected_slab_depths(2, 3, False) == {2, 4, 6}
+    assert expected_slab_depths(2, 2, True) == {1, 2, 4}
+
+
+# -- baseline -------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path, fast_report):
+    traced = fast_report.traced
+    path = str(tmp_path / "baseline.json")
+    write_baseline(traced, path)
+    bl = load_baseline(path)
+    assert set(bl) == {tc.cell.id for tc in traced}
+    # round-trip: clean against what was just written
+    assert checks.check_drift(traced, bl) == []
+    # a drifted fingerprint fails loud, with the bless hint
+    bl2 = dict(bl)
+    bl2["packed_1x1"] = {"fingerprint": "0" * 16}
+    msgs = [f.message for f in checks.check_drift(traced, bl2)]
+    assert any("stepper trace drifted" in m and "--write-baseline" in m
+               for m in msgs), msgs
+    # a missing entry is a finding too
+    bl3 = {k: v for k, v in bl.items() if k != "packed_1x1"}
+    msgs = [f.message for f in checks.check_drift(traced, bl3)]
+    assert any("no IR baseline recorded" in m for m in msgs)
+    # and a stale entry is only judged on complete-matrix runs
+    bl4 = dict(bl, ghost_cell={"fingerprint": "1" * 16})
+    assert checks.check_drift(traced, bl4, complete=False) == []
+    msgs = [f.message for f in checks.check_drift(traced, bl4,
+                                                  complete=True)]
+    assert any("unknown cell 'ghost_cell'" in m for m in msgs)
+
+
+def test_checked_in_baseline_covers_whole_matrix():
+    bl = load_baseline()
+    assert set(bl) == {c.id for c in CELLS}, (
+        "baseline.json out of sync with the matrix — regenerate with "
+        "`python -m mpi_tpu.analysis.ir --write-baseline`")
+
+
+# -- CLI ------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_tpu.analysis.ir", *args],
+        cwd=ROOT, capture_output=True, text=True)
+
+
+def test_cli_list_cells():
+    proc = _cli("--list-cells")
+    assert proc.returncode == 0
+    for c in CELLS:
+        assert c.id in proc.stdout
+
+
+def test_cli_single_cell_json():
+    proc = _cli("--cell", "packed_1x1", "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["tool"] == "mpi_tpu.analysis.ir"
+    assert data["summary"] == {"cells_traced": 1, "findings": 0,
+                               "errors": 0, "complete_matrix": False}
+    assert set(data["cells"]) == {"packed_1x1"}
+    assert re.fullmatch(r"[0-9a-f]{16}", data["cells"]["packed_1x1"])
+
+
+def test_cli_unknown_cell_is_internal_error():
+    proc = _cli("--cell", "no_such_cell")
+    assert proc.returncode == 2
+    assert "unknown matrix cell" in proc.stderr

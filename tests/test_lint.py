@@ -130,6 +130,31 @@ def test_suppression_without_reason_is_a_finding():
                for f in rep.findings)
 
 
+def test_unused_suppression_flagged():
+    _path, rep = _run_rule("lock-discipline", "suppress_unused.py")
+    assert not rep.errors, rep.errors
+    unused = {f.scope: f for f in rep.findings
+              if f.rule == "unused-suppression"}
+    # the stale (already-clean line) suppression is reported...
+    assert "stale" in unused
+    assert "matches no finding" in unused["stale"].message
+    # ...and so is the misspelled rule name, with the typo hint
+    assert "typo" in unused
+    assert "unknown rule 'lock-dicipline'" in unused["typo"].message
+    # a used suppression and one for a known-but-not-run rule are not
+    assert "used_ok" not in unused and "inactive_rule" not in unused
+    # the typo'd suppression also fails to suppress the real finding
+    assert any(f.rule == "lock-discipline" and f.scope == "typo"
+               for f in rep.findings)
+
+
+def test_unused_suppression_clean_on_repo_fixture():
+    # suppress_cases.py's justified suppression is used — adding the
+    # unused check must not make the existing fixture noisy
+    _path, rep = _run_rule("lock-discipline", "suppress_cases.py")
+    assert not any(f.rule == "unused-suppression" for f in rep.findings)
+
+
 # -- baseline -------------------------------------------------------------
 
 def test_fingerprint_ignores_line_numbers():
@@ -190,3 +215,59 @@ def test_cli_list_rules_exits_zero():
     assert proc.returncode == 0
     for name in RULES:
         assert name in proc.stdout
+
+
+def test_cli_json_format():
+    import json
+
+    proc = _cli("--rule", "donation-safety", "--no-baseline",
+                "--format", "json",
+                os.path.join(FIXTURES, "donation_bad.py"))
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["tool"] == "mpi_tpu.analysis"
+    assert data["summary"]["findings"] == len(data["findings"]) > 0
+    f = data["findings"][0]
+    assert {"rule", "path", "line", "col", "scope", "message",
+            "fingerprint"} <= set(f)
+    assert f["rule"] == "donation-safety"
+
+
+def test_cli_path_subset_skips_project_rules():
+    # a single-file run must not judge cross-file registry drift (it
+    # would report every metric the subset doesn't mention) ...
+    proc = _cli(os.path.join(ROOT, "mpi_tpu", "analysis", "__init__.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "skipping project-wide rule(s)" in proc.stderr
+    assert "obs-drift" in proc.stderr
+    # ... unless the rule is explicitly forced
+    proc2 = _cli("--rule", "obs-drift",
+                 os.path.join(ROOT, "mpi_tpu", "analysis", "__init__.py"))
+    assert "skipping project-wide" not in proc2.stderr
+
+
+def test_cli_changed_only(tmp_path):
+    # a throwaway git repo with one dirty in-scope file, one clean one
+    repo = tmp_path / "repo"
+    (repo / "mpi_tpu").mkdir(parents=True)
+    (repo / "mpi_tpu" / "__init__.py").write_text("")
+    (repo / "mpi_tpu" / "clean.py").write_text("x = 1\n")
+    (repo / "mpi_tpu" / "other.txt").write_text("not python\n")
+    env = {**os.environ,
+           "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for cmd in (["git", "init", "-q"],
+                ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=repo, env=env, check=True,
+                       capture_output=True)
+    (repo / "mpi_tpu" / "dirty.py").write_text("y = 2\n")
+
+    from mpi_tpu.analysis.__main__ import _changed_paths
+    got = _changed_paths(str(repo))
+    assert got == [str(repo / "mpi_tpu" / "dirty.py")]
+
+    # --changed-only + explicit paths is a usage error
+    proc = _cli("--changed-only", "mpi_tpu/config.py")
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-shot static gate: AST lint -> IR verify -> obs registry smoke.
+#
+# All three stages share the exit-code contract (0 clean, 1 findings,
+# 2 internal error); the gate runs every stage even after a failure so
+# one CI invocation reports everything, then exits with the worst
+# status seen.  Usage:
+#
+#   tools/ci_gate.sh                 # full gate (complete IR matrix)
+#   IR_ARGS=--fast tools/ci_gate.sh  # tier-1-sized IR subset
+#   LINT_ARGS=--changed-only tools/ci_gate.sh
+#
+set -u
+cd "$(dirname "$0")/.."
+
+worst=0
+note() { printf '\n=== ci_gate: %s ===\n' "$1"; }
+track() {
+    local rc=$1
+    if [ "$rc" -gt "$worst" ]; then worst=$rc; fi
+}
+
+note "AST lint (python -m mpi_tpu.analysis ${LINT_ARGS:-})"
+# shellcheck disable=SC2086
+python -m mpi_tpu.analysis ${LINT_ARGS:-}
+track $?
+
+note "IR verify (python -m mpi_tpu.analysis.ir ${IR_ARGS:-})"
+# shellcheck disable=SC2086
+python -m mpi_tpu.analysis.ir ${IR_ARGS:-}
+track $?
+
+note "obs registry smoke (tools/obs_smoke.py --lint-only)"
+python tools/obs_smoke.py --lint-only
+track $?
+
+note "result: exit $worst"
+exit "$worst"

@@ -441,6 +441,12 @@ class Engine:
         # only consulted on the compile (miss) path — the per-dispatch
         # hot path stays untouched so obs=None is the pre-obs code
         self.obs = None
+        # cost-card state (obs/cost.py): the serve layer stamps the
+        # compact plan tag (sig_label) next to obs; cards are captured
+        # per (depth, B) on real compile misses, only when obs is
+        # installed — obs=None engines never pay the analysis/retrace
+        self.sig_label = None
+        self._cost_cards = {}
 
     @property
     def col_limit(self):
@@ -536,6 +542,10 @@ class Engine:
             if self.obs is not None:
                 self.obs.compile_wall.observe(dt)
                 self.obs.event("compile", dt, t0, depth=n)
+                self._capture_cost_card(
+                    c, n, 0,
+                    lambda: jax.make_jaxpr(
+                        lambda g: self._evolve(g, n))(grid))
             return c
 
     def ensure_compiled_batched(self, grids, n: int):
@@ -563,6 +573,10 @@ class Engine:
             if self.obs is not None:
                 self.obs.compile_wall.observe(dt)
                 self.obs.event("compile", dt, t0, depth=n, B=key[1])
+                self._capture_cost_card(
+                    c, n, key[1],
+                    lambda: jax.make_jaxpr(
+                        lambda g: self._get_batched_evolve()(g, n))(grids))
             return c
 
     def _compile_with_fallback(self, compile_fn):
@@ -589,7 +603,36 @@ class Engine:
             self._compiled.clear()
             self._compiled_batched.clear()
             self._evolve_batched = None
+            # the cards described the Pallas-built executables; the
+            # re-capture on each table's next miss replaces them
+            self._cost_cards.clear()
             return compile_fn()
+
+    def _capture_cost_card(self, compiled, depth: int, batch: int,
+                           trace_thunk) -> None:
+        """Best-effort CostCard for a fresh executable (obs/cost.py) —
+        caller holds ``_compile_lock`` and already checked ``self.obs``.
+        Capture only reads the compiled artifact (and, when XLA reports
+        no flops, retraces the stepper once on the miss path); a card
+        that cannot be built is dropped, never an engine error."""
+        try:
+            from mpi_tpu.obs.cost import capture_card
+
+            self._cost_cards[(depth, batch)] = capture_card(
+                compiled, sig_label=self.sig_label, depth=depth,
+                batch=batch, trace_thunk=trace_thunk)
+        except Exception:  # noqa: BLE001 — metering must never break serving
+            pass
+
+    def cost_card(self, depth: int, batch: int = 0):
+        """The captured card for the (depth, B) executable, or None (no
+        obs, capture failure, or the compile hasn't happened yet)."""
+        return self._cost_cards.get((depth, batch))
+
+    def cost_cards(self) -> list:
+        """Snapshot of every captured card (usage endpoint readout)."""
+        with self._compile_lock:
+            return list(self._cost_cards.values())
 
     def _get_batched_evolve(self):
         """evolve_batched(grids, steps): vmap of this engine's evolve over

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from mpi_tpu.obs.ledger import UsageLedger
 from mpi_tpu.obs.metrics import (
     COMPILE_BUCKETS, IO_BUCKETS, LATENCY_BUCKETS, OCCUPANCY_BUCKETS,
     MetricsRegistry,
@@ -51,6 +52,9 @@ class Obs:
                  trace_log: Optional[str] = None):
         self.tracer = Tracer(capacity=trace_capacity, log_path=trace_log)
         self.metrics = MetricsRegistry()
+        # per-session/per-signature usage accounting (obs/ledger.py),
+        # fed at the dispatch commit sites; process-local by design
+        self.ledger = UsageLedger()
         m = self.metrics
         self.dispatch_latency = m.histogram(
             "mpi_tpu_dispatch_latency_seconds",
@@ -264,6 +268,72 @@ class Obs:
         m.counter_fn("mpi_tpu_trace_spans_total",
                      "Spans/events recorded by the tracer",
                      lambda: self.tracer.stats()["recorded"])
+
+        # -- usage ledger (ISSUE 10): per-SIGNATURE series only — the
+        # per-session rows stay on /usage so scrape cardinality is
+        # bounded by distinct plans, never by tenant count
+        ledger = self.ledger
+
+        m.counter_fn("mpi_tpu_usage_device_seconds_total",
+                     "Committed device sync wall per plan signature",
+                     lambda: ledger.signature_series("device_s"))
+        m.counter_fn("mpi_tpu_usage_syncs_total",
+                     "Committed dispatches (device syncs) per plan "
+                     "signature",
+                     lambda: ledger.signature_series("syncs"))
+        m.counter_fn("mpi_tpu_usage_generations_total",
+                     "Generations advanced per plan signature",
+                     lambda: ledger.signature_series("generations"))
+        m.counter_fn("mpi_tpu_usage_cells_total",
+                     "Cell-updates served per plan signature",
+                     lambda: ledger.signature_series("cells"))
+        m.counter_fn("mpi_tpu_usage_flops_total",
+                     "Cost-card-derived FLOPs served per plan signature",
+                     lambda: ledger.signature_series("flops"))
+
+        def _cost_card_counts():
+            counts = {"xla": 0, "opcount": 0}
+            for e in _live_engines(manager):
+                for c in e.cost_cards():
+                    counts[c.source] = counts.get(c.source, 0) + 1
+            return [({"source": k}, v) for k, v in counts.items()]
+
+        m.gauge_fn("mpi_tpu_cost_cards",
+                   "Captured executable cost cards by capture source",
+                   _cost_card_counts)
+
+        def _roofline_efficiency():
+            # achieved cells/s (ledger) over the cost-model bound (the
+            # captured cards' trip-count-safe ops/cell into the roof),
+            # per live signature — computed at scrape time
+            from mpi_tpu.obs.cost import (
+                ops_per_cell_estimate, roof_ops_per_s,
+            )
+
+            roof = roof_ops_per_s()
+            rows = ledger.signature_rows()
+            out = []
+            seen = set()
+            for e in _live_engines(manager):
+                label = getattr(e, "sig_label", None)
+                if label is None or label in seen:
+                    continue
+                seen.add(label)
+                row = rows.get(label)
+                if not row or row["device_s"] <= 0:
+                    continue
+                opc = ops_per_cell_estimate(e.cost_cards(), e.config.cells)
+                if opc is None:
+                    continue
+                bound = roof / opc
+                out.append(({"sig": label},
+                            (row["cells"] / row["device_s"]) / bound))
+            return out
+
+        m.gauge_fn("mpi_tpu_roofline_efficiency",
+                   "Achieved cells/s over the cost-model roofline bound, "
+                   "per plan signature",
+                   _roofline_efficiency)
 
     # -- export ----------------------------------------------------------
 

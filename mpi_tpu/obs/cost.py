@@ -1,0 +1,150 @@
+"""Cost cards: a per-executable arithmetic cost model for the serve
+stack (ISSUE 10 tentpole).
+
+When an :class:`~mpi_tpu.backends.tpu.Engine` compiles a stepper (a real
+miss under ``_compile_lock``), the serve layer captures one
+:class:`CostCard` from the compiled artifact — XLA's
+``cost_analysis()``/``memory_analysis()`` where the backend reports
+them, falling back to counting ALU lane-ops in the traced jaxpr
+(:mod:`mpi_tpu.obs.opcount`) where it does not (``source`` records
+which).  Cards are keyed per (plan signature, depth, B): the engine IS
+the signature (one compiled engine per :func:`~mpi_tpu.config.plan_signature`),
+so the engine owns its cards and the ledger/`/usage` join them back to
+signature rows at read time.
+
+Capture only READS compiled artifacts and traced jaxprs — it never
+changes what gets traced or lowered, so the IR verifier's baselines and
+``--no-obs`` bit-identity are untouched.
+
+Units, stated so the numbers read honestly: XLA's ``flops`` field counts
+classic floating/integer ops; the opcount fallback counts VPU lane-ops
+(the roofline currency).  For the bit-packed engines these agree to
+within the SWAR packing factor; every consumer carries ``source`` so the
+two are never silently mixed across a comparison.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+# the measured VPU u32 lane-op throughput roof (perf/profile_ladder_g8
+# chain measurement; tools/roofline.py --roof default).  The live
+# roofline-efficiency gauge divides by this unless the server was given
+# a measured roof for its actual device.
+DEFAULT_ROOF_OPS_PER_S = 1.95e12
+
+
+def roof_ops_per_s() -> float:
+    """The ops/s roof the live roofline-efficiency readout divides by:
+    ``MPI_TPU_ROOF_OPS_PER_S`` when set (a roof measured for THIS box,
+    e.g. ``tools/roofline.py --measure-roof``), else the committed TPU
+    chain measurement — on XLA:CPU the gauge then reads as 'fraction of
+    the flagship TPU roof', which is the honest cross-platform number."""
+    import os
+
+    raw = os.environ.get("MPI_TPU_ROOF_OPS_PER_S")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_ROOF_OPS_PER_S
+
+
+@dataclass(frozen=True)
+class CostCard:
+    """The arithmetic price of ONE execution of a compiled stepper."""
+
+    sig_label: str              # compact plan tag (serve/cache.signature_label)
+    depth: int                  # generations advanced per execution (n)
+    batch: int                  # stacked boards (B); 0 = the solo executable
+    flops: float                # est. FLOPs (or lane-ops, see source)
+    bytes_accessed: float       # est. HBM bytes touched (0 if unreported)
+    peak_memory_bytes: float    # arg + output + temp of the executable
+    code_size_bytes: float      # generated code size (0 if unreported)
+    source: str                 # "xla" | "opcount"
+
+    @property
+    def boards(self) -> int:
+        """Boards advanced per execution (the solo executable runs 1)."""
+        return self.batch if self.batch else 1
+
+    def ops_per_cell(self, cells: int) -> float:
+        """flops normalized per cell-update of one execution."""
+        denom = float(cells) * max(self.depth, 1) * self.boards
+        return self.flops / denom if denom else 0.0
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+def ops_per_cell_estimate(cards, cells: int):
+    """The per-cell-update op estimate for one signature, from its
+    captured cards.  Depth-1 executables are preferred: XLA:CPU's
+    ``cost_analysis`` counts a while-loop body ONCE, so depth>1
+    programs under-report by their trip count; the depth-1 program has
+    no loop to miscount.  Falls back to the min over whatever was
+    reported; ``None`` when no card carries flops."""
+    vals = [c.ops_per_cell(cells) for c in cards if c.flops > 0]
+    depth1 = [c.ops_per_cell(cells) for c in cards
+              if c.flops > 0 and c.depth == 1]
+    if depth1:
+        return min(depth1)
+    return min(vals) if vals else None
+
+
+def _first_analysis(compiled):
+    """``cost_analysis()`` returns a dict on new jaxlibs, a per-device
+    list of dicts on the ones shipped here — normalize to one dict."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
+def capture_card(compiled, *, sig_label: Optional[str], depth: int,
+                 batch: int, trace_thunk=None) -> CostCard:
+    """Best-effort CostCard for a just-compiled executable.
+
+    ``trace_thunk`` (optional) retraces the stepper and returns its
+    closed jaxpr; it is only called when XLA reports no flops (the
+    XLA:CPU builds here DO report them, but the field is not contractual
+    across backends).  Raises only if both channels fail AND no thunk
+    was given — callers treat any exception as "no card".
+    """
+    flops = bytes_accessed = None
+    try:
+        ca = _first_analysis(compiled)
+        f = float(ca.get("flops", 0.0) or 0.0)
+        if f > 0.0:
+            flops = f
+        b = float(ca.get("bytes accessed", 0.0) or 0.0)
+        if b > 0.0:
+            bytes_accessed = b
+    except Exception:  # noqa: BLE001 — analysis support varies by backend
+        pass
+    peak = code = 0.0
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            peak = float(getattr(ma, "argument_size_in_bytes", 0)
+                         + getattr(ma, "output_size_in_bytes", 0)
+                         + getattr(ma, "temp_size_in_bytes", 0))
+            code = float(getattr(ma, "generated_code_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001
+        pass
+    source = "xla"
+    if flops is None:
+        if trace_thunk is None:
+            raise ValueError("cost_analysis reported no flops and no "
+                             "trace_thunk was given")
+        from mpi_tpu.obs.opcount import count_ops
+
+        flops = count_ops(trace_thunk())
+        source = "opcount"
+    return CostCard(sig_label=sig_label or "unkeyed", depth=int(depth),
+                    batch=int(batch), flops=float(flops),
+                    bytes_accessed=float(bytes_accessed or 0.0),
+                    peak_memory_bytes=peak, code_size_bytes=code,
+                    source=source)

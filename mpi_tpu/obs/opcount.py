@@ -1,0 +1,62 @@
+"""Jaxpr ALU lane-op counting — the counted-ops core of the roofline
+audit, promoted out of ``tools/roofline.py`` so the live service can use
+it too: the cost-card capture (``obs/cost.py``) falls back to counting a
+stepper's traced jaxpr wherever XLA's ``cost_analysis()`` reports no
+FLOPs for the compiled executable.
+
+The count is arithmetic, not an estimate: every elementwise ALU
+primitive in the (closed) jaxpr costs ``prod(shape of its first
+output)`` lane-ops, recursing into sub-jaxprs (scan/while/cond/pjit
+bodies).  Memory-movement primitives (slice/concat/pad/roll/transpose)
+are NOT ALU ops and are excluded — on bandwidth-bound programs a roof
+ratio computed from this count therefore *understates* the gap.
+
+Unlike the tool, this module performs NO platform pinning and touches no
+environment: it only traces (``jax.make_jaxpr``), which needs no device.
+``tools/roofline.py`` keeps its own import-time CPU pin and re-exports
+these names for backward compatibility.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# elementwise ALU primitives that occupy a VPU lane-op per output element
+ALU_PRIMS = {
+    "and", "or", "xor", "not", "add", "sub", "mul",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "max", "min",
+    "population_count", "rem", "convert_element_type",
+}
+
+
+def _count_ops(jaxpr, consts_env=None) -> float:
+    """Total ALU lane-ops in a (closed) jaxpr, recursing into sub-jaxprs;
+    each primitive costs prod(shape of its first output)."""
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
+            sub = eqn.params.get(key)
+            if sub is not None:
+                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                total += _count_ops(inner)
+        if "branches" in eqn.params:
+            for br in eqn.params["branches"]:
+                total += _count_ops(br.jaxpr if hasattr(br, "jaxpr") else br)
+        if eqn.primitive.name in ALU_PRIMS:
+            aval = eqn.outvars[0].aval
+            total += float(np.prod(aval.shape)) if aval.shape else 1.0
+    return total
+
+
+def count_ops(closed) -> float:
+    """ALU lane-ops of a ``jax.make_jaxpr`` result (or a bare jaxpr)."""
+    return _count_ops(closed.jaxpr if hasattr(closed, "jaxpr") else closed)
+
+
+def ops_per_cell(step_fn, example, cells: int) -> float:
+    """Lane-ops per cell of one traced application of ``step_fn``."""
+    import jax
+
+    closed = jax.make_jaxpr(step_fn)(example)
+    return _count_ops(closed.jaxpr) / cells

@@ -166,6 +166,16 @@ class AioServer:
         self.frames_dropped = 0
         self.requests_handled = 0
         self.parked_total = 0
+        self.resolved_dispatched = 0
+
+        # parked-waiter fairness: a resolve burst (one batched commit can
+        # resolve hundreds of parked tickets at once) must not flood the
+        # small worker pool ahead of fresh requests.  Unparked waiters
+        # queue here and drain FIFO, at most ``workers`` of them on the
+        # pool at a time, so a new request's job is always behind a
+        # bounded prefix of the burst instead of the whole of it.
+        self._resolved_fifo: deque = deque()
+        self._dispatching = 0           # request jobs on the pool now
 
         if self.obs is not None:
             m = self.obs.metrics
@@ -186,6 +196,10 @@ class AioServer:
             m.counter_fn("mpi_tpu_aio_frames_dropped_total",
                          "Stream frames dropped to latest (slow consumer)",
                          lambda: self.frames_dropped)
+            m.gauge_fn("mpi_tpu_aio_resolve_queue_depth",
+                       "Unparked waiters queued behind the fairness "
+                       "bound (FIFO, at most --aio-workers on the pool)",
+                       lambda: len(self._resolved_fifo))
 
     def _count_conns(self, pred) -> int:
         # scrape-time read of loop-thread state: a concurrent mutation
@@ -430,6 +444,7 @@ class AioServer:
 
     def _submit(self, conn: _Conn, req: Request) -> None:
         conn.inflight = True
+        self._dispatching += 1          # loop thread only
 
         def done(fut):
             try:
@@ -445,8 +460,10 @@ class AioServer:
                           "aio").add_done_callback(done)
 
     def _finish_request(self, conn: _Conn, resp) -> None:
+        self._dispatching -= 1
         conn.inflight = False
         self._deliver(conn, resp)
+        self._drain_resolved()          # a worker freed: next waiter
 
     def _deliver(self, conn: _Conn, resp) -> None:
         if conn.closed:
@@ -575,7 +592,21 @@ class AioServer:
             return                      # stale wake (timeout + resolve race)
         conn.parked = None
         self._cancel_park(info)
-        self._submit(conn, info["req"])
+        # fairness: never straight to the pool — through the FIFO, so a
+        # resolve burst dispatches at most ``workers`` waiters at a time
+        # and fresh requests interleave instead of starving behind it
+        self._resolved_fifo.append((conn, info["req"]))
+        self._drain_resolved()
+
+    def _drain_resolved(self) -> None:
+        """Dispatch queued unparked waiters FIFO while the pool has a
+        free worker (loop thread only — no lock needed)."""
+        while self._resolved_fifo and self._dispatching < self.workers:
+            conn, req = self._resolved_fifo.popleft()
+            if conn.closed:
+                continue                # died while queued
+            self.resolved_dispatched += 1
+            self._submit(conn, req)
 
     def _cancel_park(self, info: dict) -> None:
         if info.get("timer") is not None:
@@ -705,6 +736,8 @@ class AioServer:
             "frames_dropped": self.frames_dropped,
             "requests_handled": self.requests_handled,
             "parked_total": self.parked_total,
+            "resolved_dispatched": self.resolved_dispatched,
+            "resolve_queue_depth": len(self._resolved_fifo),
             "workers": self.workers,
             "stream_buffer": self.stream_buffer,
         }

@@ -277,6 +277,16 @@ class MicroBatcher:
                       request_ids=[e.rid for e in group])
             obs.occupancy_series.observe(B)
             obs.dispatch_batched.observe(t2 - t1)
+            # usage ledger: ONE sync split evenly across the B riders
+            # (shares sum to the leader's block time); the failed-batch
+            # path above commits nothing here — each solo fallback
+            # records its own sync in _step_locked, never both
+            card = engine.cost_card(steps, B)
+            per_flops = card.flops / B if card is not None else 0.0
+            obs.ledger.record(
+                "batched", engine.sig_label, t2 - t1,
+                [(e.session.id, steps, steps * e.session.config.cells,
+                  per_flops) for e in group])
         for e, grid in zip(group, boards):
             s = e.session
             s.setup_s += t1 - t0

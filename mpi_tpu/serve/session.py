@@ -71,7 +71,7 @@ from mpi_tpu.config import ConfigError, GolConfig, plan_signature
 from mpi_tpu.models.rules import rule_from_name
 from mpi_tpu.serve import recovery
 from mpi_tpu.serve.batch import MicroBatcher
-from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.cache import EngineCache, signature_label
 from mpi_tpu.serve.ticket import AsyncDispatcher, TicketQueueFullError
 from mpi_tpu.utils.hashinit import init_tile_np
 
@@ -425,6 +425,10 @@ class SessionManager:
         # same idempotent-install idiom: a cached engine follows THIS
         # manager's obs setting (None detaches a previous manager's)
         engine.obs = self.obs
+        # the compact plan tag keys the engine's cost cards and the usage
+        # ledger's per-signature series (bounded cardinality: signatures,
+        # never sessions)
+        engine.sig_label = signature_label(sig)
         grid = engine.init_grid(initial=initial, seed=config.seed)
         # precompile the requested segment set (a no-op on a cache hit —
         # the signature pins the set, so the hit engine already has it)
@@ -869,6 +873,19 @@ class SessionManager:
                               sid=session.id, steps=steps,
                               block_s=round(t2 - td, 9))
                 obs.dispatch_solo.observe(t2 - t1)
+                # usage ledger: one committed sync.  The unit path is an
+                # async solo chain (ONE block for `steps` depth-1
+                # executions); its FLOPs are the depth-1 card times the
+                # chain length.  A batched-path failure re-enters here,
+                # so fallbacks are counted exactly once — by this site.
+                card = session.engine.cost_card(1 if unit else steps)
+                flops = 0.0 if card is None else (
+                    card.flops * steps if unit else card.flops)
+                obs.ledger.record(
+                    "unit" if unit else "solo", session.engine.sig_label,
+                    t2 - t1,
+                    [(session.id, steps, steps * session.config.cells,
+                      flops)])
                 if session.engine.sparse_plan is not None:
                     # activity readout AFTER the sync (tiny tile-map
                     # reduce + fetch) — the span every sparse dispatch
@@ -889,6 +906,16 @@ class SessionManager:
                 obs.event("host_step", t1 - t0, t0,
                           sid=session.id, steps=steps)
                 obs.dispatch_host.observe(t1 - t0)
+                # host wall is metered apart from device-seconds (the
+                # ledger's host_s bucket); degraded tpu sessions keep
+                # their signature row, plain host backends get "-"
+                obs.ledger.record(
+                    "host",
+                    signature_label(session.plan_sig)
+                    if session.plan_sig is not None else None,
+                    t1 - t0,
+                    [(session.id, steps, steps * session.config.cells,
+                      0.0)])
         session.generation += steps
         self._checkpoint(session)
         self._notify_step(session)
@@ -1098,6 +1125,12 @@ class SessionManager:
             d["queue_depth"] = self.dispatcher.queued_for(session.id)
             d["tickets_pending"] = self.dispatcher.pending_for(session.id)
             d["tickets_completed"] = self.dispatcher.completed_for(session.id)
+        if self.obs is not None:
+            # the session's usage-ledger row (process-local metering;
+            # absent until the first committed dispatch)
+            usage = self.obs.ledger.session_row(session.id)
+            if usage is not None:
+                d["usage"] = usage
         return d
 
     def _session_list(self):
@@ -1136,8 +1169,59 @@ class SessionManager:
 
             obs_stats = self.obs.stats()
             obs_stats["breakdown"] = compile_execute_breakdown(self)
+            obs_stats["usage"] = self.obs.ledger.totals()
             out["obs"] = obs_stats
         return out
+
+    def usage(self) -> dict:
+        """The ``GET /usage`` payload: ledger totals, per-session rows,
+        and per-signature rows joined with each live engine's cost cards
+        and a roofline readout (achieved cells/s over the cost-model
+        bound).  Raises :class:`RuntimeError` when obs is off — the
+        transport maps it to the same 404 as ``/metrics``.
+
+        The ledger is process-local: a restart (or restore-from-
+        checkpoint) starts metering from zero, by design."""
+        if self.obs is None:
+            raise RuntimeError("usage metering needs observability")
+        from mpi_tpu.obs.cost import ops_per_cell_estimate, roof_ops_per_s
+        from mpi_tpu.obs.profile import _live_engines
+
+        roof = roof_ops_per_s()
+        ledger = self.obs.ledger
+        signatures = ledger.signature_rows()
+        by_label = {}
+        for eng in _live_engines(self):
+            label = getattr(eng, "sig_label", None)
+            if label is not None and label not in by_label:
+                by_label[label] = eng
+        sig_rows = []
+        for label in sorted(signatures):
+            row = dict(signatures[label], signature=label)
+            eng = by_label.get(label)
+            if eng is not None:
+                cards = eng.cost_cards()
+                row["cost_cards"] = [c.as_dict() for c in cards]
+                ops_per_cell = ops_per_cell_estimate(cards,
+                                                     eng.config.cells)
+                if ops_per_cell is not None and row["device_s"] > 0:
+                    bound = roof / ops_per_cell
+                    achieved = row["cells"] / row["device_s"]
+                    row["roofline"] = {
+                        "ops_per_cell": ops_per_cell,
+                        "bound_cells_per_s": bound,
+                        "achieved_cells_per_s": achieved,
+                        "efficiency": achieved / bound,
+                    }
+            sig_rows.append(row)
+        return {
+            "totals": ledger.totals(),
+            "sessions": ledger.session_rows(),
+            "signatures": sig_rows,
+            "roof_ops_per_s": roof,
+            "note": "process-local: restarts and restores reset nothing "
+                    "but start metering from zero",
+        }
 
     def health(self) -> dict:
         """The deep ``/healthz`` payload.  ``ok`` is False — the probe

@@ -496,6 +496,19 @@ class AsyncDispatcher:
                 obs.occupancy_series.observe(B)
                 (obs.dispatch_batched if B > 1
                  else obs.dispatch_solo).observe(t2 - t1)
+                # usage ledger: the whole chain is ONE sync (one
+                # block_until_ready), however many depth-1 rounds it
+                # stacked; FLOPs estimate from the chain's opening
+                # (depth-1, B) executable, per board-generation — the
+                # cohort peel shrinks B mid-chain, which this ignores
+                card = engine.cost_card(1, B if B > 1 else 0)
+                pbg = (card.flops / card.boards
+                       if card is not None else 0.0)
+                obs.ledger.record(
+                    "unit", engine.sig_label, t2 - t1,
+                    [(s.id, t.remaining,
+                      t.remaining * s.config.cells,
+                      pbg * t.remaining) for t, s in live])
             per_board = (t2 - t1) / B
             for (t, s), grid in zip(live, boards):
                 adv = t.remaining       # cohort chains run to completion

@@ -243,6 +243,8 @@ class AppCore:
             return "stats", None, None
         if parts == ["metrics"]:
             return "metrics", None, None
+        if parts == ["usage"]:
+            return "usage", None, None
         if parts == ["debug", "profile"]:
             return "profile", None, None
         if len(parts) == 2 and parts[0] == "result":
@@ -319,6 +321,13 @@ class AppCore:
             text = obs.render_metrics()
             return Response(200, text.encode("utf-8"),
                             "text/plain; version=0.0.4; charset=utf-8")
+        if kind == "usage" and method == "GET":
+            # same off-switch contract as /metrics: usage metering rides
+            # the obs handle, so --no-obs answers the same structured 404
+            if obs is None:
+                return json_response(404, {
+                    "error": "observability is disabled (--no-obs)"})
+            return json_response(200, mgr.usage())
         if kind == "profile" and method == "POST":
             return self._profile(req)
         if kind == "healthz" and method == "GET":

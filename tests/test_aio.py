@@ -469,6 +469,63 @@ def test_stream_drop_to_latest_backpressure():
         srv.server_close()
 
 
+def test_resolve_burst_drains_fifo_bounded_by_free_workers():
+    """Unit-level: a ticket-resolve burst must not flood the worker pool
+    — unparked waiters queue FIFO with at most ``workers`` of them on
+    the pool at once, each finishing dispatch admits exactly the next
+    one in park order, and a connection that died while queued is
+    skipped rather than dispatched."""
+    srv = AioServer(port=0, workers=2)
+    socks = []
+    try:
+        submitted = []
+
+        def fake_submit(conn, req):
+            # what _submit does minus the pool: claim a worker slot
+            conn.inflight = True
+            srv._dispatching += 1
+            submitted.append(req)
+
+        srv._submit = fake_submit
+        conns = []
+        for i in range(5):
+            a, b = socket.socketpair()
+            a.setblocking(False)
+            socks += [a, b]
+            conn = _Conn(a)
+            srv._conns[conn.fd] = conn
+            info = {"tid": f"t{i}", "req": f"req{i}", "timer": None,
+                    "fn": None}
+            conn.parked = info
+            conns.append((conn, info))
+
+        # the burst: every waiter resolves at once
+        for conn, info in conns:
+            srv._unpark(conn, info)
+        assert submitted == ["req0", "req1"]    # bounded by workers
+        assert srv._dispatching == 2
+        st = srv.stats()
+        assert st["resolve_queue_depth"] == 3
+        assert st["resolved_dispatched"] == 2
+
+        # conn 3 dies while queued: skipped, never dispatched
+        conns[3][0].closed = True
+
+        # each freed worker admits exactly the NEXT waiter, FIFO
+        srv._dispatching -= 1
+        srv._drain_resolved()
+        assert submitted == ["req0", "req1", "req2"]
+        srv._dispatching -= 1
+        srv._drain_resolved()
+        assert submitted == ["req0", "req1", "req2", "req4"]
+        assert srv.stats()["resolve_queue_depth"] == 0
+        assert srv.stats()["resolved_dispatched"] == 4
+    finally:
+        for s in socks:
+            s.close()
+        srv.server_close()
+
+
 # --------------------------------------------------- step notifications
 
 

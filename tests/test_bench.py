@@ -688,3 +688,62 @@ def test_bench_repeated_main_does_not_leak_history(monkeypatch, capsys):
     n1 = len(list(bench._HISTORY))
     run_main(capsys)
     assert len(list(bench._HISTORY)) == n1
+
+
+# --------------------------------------------------- bench_gate (PR 10)
+
+
+def _bench_gate():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import bench_gate
+    return bench_gate
+
+
+def test_bench_gate_envelope_skips_unusable_runs(tmp_path):
+    bg = _bench_gate()
+    recs = [
+        (1, {"rc": 1, "parsed": None}),                    # failed run
+        (2, {"rc": 0, "parsed": {"value": 4.0e9, "platform": "cpu",
+                                 "size": 8192, "gens": 8}}),
+        (3, {"rc": 0, "parsed": {"value": 5.0e9, "platform": "cpu",
+                                 "size": 8192, "gens": 8}}),
+        (4, {"rc": 0, "parsed": {"value": 0.0, "error": "boom",
+                                 "platform": "cpu", "size": 8192,
+                                 "gens": 8}}),             # error record
+    ]
+    env = bg.build_envelope(recs)
+    assert env == {("cpu", 8192, 8): {"lo": 4.0e9, "hi": 5.0e9,
+                                      "runs": [2, 3]}}
+
+
+def test_bench_gate_flags_degraded_passes_clean():
+    bg = _bench_gate()
+    env = {("cpu", 8192, 8): {"lo": 4.0e9, "hi": 5.0e9, "runs": [2, 3]}}
+    clean = {"value": 4.2e9, "platform": "cpu", "size": 8192, "gens": 8}
+    ok, msg = bg.gate(clean, env, tolerance=0.25)
+    assert ok, msg
+    degraded = dict(clean, value=2.0e9)   # 50% below the floor
+    ok, msg = bg.gate(degraded, env, tolerance=0.25)
+    assert not ok and "REGRESSION" in msg
+    # a config without history cannot regress — pass with a note
+    other = dict(clean, size=256)
+    ok, msg = bg.gate(other, env, tolerance=0.25)
+    assert ok and "no history" in msg
+    # a broken fresh run is a failure, not a silent pass
+    ok, _ = bg.gate({"error": "bench blew up", "value": 0}, env, 0.25)
+    assert not ok
+    ok, _ = bg.gate(None, env, 0.25)
+    assert not ok
+
+
+def test_bench_gate_reads_committed_trajectory():
+    """The real BENCH_r*.json files at the repo root must parse into a
+    non-empty envelope — the CI stage's --dry-run depends on it."""
+    bg = _bench_gate()
+    runs = bg.load_history()
+    assert len(runs) >= 5
+    env = bg.build_envelope(runs)
+    assert ("cpu", 8192, 8) in env
+    slot = env[("cpu", 8192, 8)]
+    assert 0 < slot["lo"] <= slot["hi"]

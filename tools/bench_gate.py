@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Bench-regression gate over the committed ``BENCH_r*.json`` trajectory.
+
+Every PR's driver appends one ``BENCH_rNN.json`` (``{n, cmd, rc, tail,
+parsed}``; ``parsed`` is ``bench.py``'s final JSON line).  That history
+is a per-config throughput envelope — this tool turns it into a CI
+stage:
+
+1. load ``BENCH_r*.json`` from the repo root and build the envelope:
+   ``(platform, size, gens) -> [min, max]`` over the usable runs
+   (``rc == 0``, a parsed record with a positive ``value`` and no
+   ``error``);
+2. obtain a FRESH number — ``python bench.py`` by default, or a
+   synthetic one via ``--from-json``/``--value`` (how the acceptance
+   test injects a degraded run without owning slow hardware);
+3. fail (exit 1) when the fresh value falls more than ``--tolerance``
+   below the envelope floor for its config; a config with no history
+   passes with a note (there is nothing to regress against);
+4. append the fresh run as the next ``BENCH_rNN.json`` (suppress with
+   ``--no-write``; synthetic runs never write).
+
+``--dry-run`` stops after step 1 and prints the envelope — the mode
+``tools/ci_gate.sh`` uses on XLA:CPU boxes, where a fresh wall-clock
+number would gate on the runner's CPU, not the code.
+
+Stdlib only; ``bench.py`` is invoked as a subprocess so this tool never
+imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_history(root: str = ROOT):
+    """The committed trajectory, sorted by run number: ``[(n, record)]``.
+    Unreadable files are skipped loudly on stderr, never fatal."""
+    runs = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _BENCH_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_gate: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        runs.append((int(m.group(1)), rec))
+    runs.sort()
+    return runs
+
+
+def _usable(rec: dict):
+    """The parsed record of a run the envelope may trust, else None."""
+    parsed = rec.get("parsed")
+    if rec.get("rc") != 0 or not isinstance(parsed, dict):
+        return None
+    if parsed.get("error") or not parsed.get("value"):
+        return None
+    if float(parsed["value"]) <= 0:
+        return None
+    return parsed
+
+
+def config_key(parsed: dict):
+    return (str(parsed.get("platform")), parsed.get("size"),
+            parsed.get("gens"))
+
+
+def build_envelope(runs):
+    """``(platform, size, gens) -> {"lo", "hi", "runs": [n, ...]}``."""
+    env = {}
+    for n, rec in runs:
+        parsed = _usable(rec)
+        if parsed is None:
+            continue
+        key = config_key(parsed)
+        v = float(parsed["value"])
+        slot = env.setdefault(key, {"lo": v, "hi": v, "runs": []})
+        slot["lo"] = min(slot["lo"], v)
+        slot["hi"] = max(slot["hi"], v)
+        slot["runs"].append(n)
+    return env
+
+
+def run_bench(python: str = sys.executable, timeout_s: float = 1800.0):
+    """Run ``bench.py`` and return a ``BENCH_rNN``-shaped record.
+    ``parsed`` is the last stdout line that decodes as a JSON object —
+    ``bench.py``'s contract is that its final line always is one."""
+    cmd = [python, os.path.join(ROOT, "bench.py")]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout_s, cwd=ROOT)
+    parsed = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(line)
+            break
+        except ValueError:
+            continue
+    tail = (proc.stdout + proc.stderr)[-2000:]
+    return {"cmd": " ".join(cmd), "rc": proc.returncode, "tail": tail,
+            "parsed": parsed}
+
+
+def gate(parsed: dict, envelope: dict, tolerance: float):
+    """(ok, message) for one fresh parsed record against the envelope."""
+    if parsed is None or parsed.get("error"):
+        return False, f"fresh run produced no usable record: {parsed}"
+    value = float(parsed.get("value") or 0.0)
+    if value <= 0:
+        return False, f"fresh run reported non-positive value: {value}"
+    key = config_key(parsed)
+    slot = envelope.get(key)
+    if slot is None:
+        return True, (f"config {key} has no history — nothing to regress "
+                      f"against (envelope keys: {sorted(envelope)})")
+    floor = slot["lo"] * (1.0 - tolerance)
+    verdict = (f"{value:.4g} {parsed.get('unit', '')} vs envelope "
+               f"[{slot['lo']:.4g}, {slot['hi']:.4g}] from runs "
+               f"{slot['runs']} (floor {floor:.4g} at "
+               f"tolerance {tolerance:.0%})")
+    if value < floor:
+        return False, f"REGRESSION: {verdict}"
+    return True, f"ok: {verdict}"
+
+
+def next_run_path(runs, root: str = ROOT):
+    n = max((n for n, _ in runs), default=0) + 1
+    return n, os.path.join(root, f"BENCH_r{n:02d}.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed fraction below the envelope floor "
+                         "(default 0.25 — wall clocks differ across "
+                         "runners; the gate catches collapses, not noise)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="parse the history, print the envelope, exit")
+    ap.add_argument("--from-json", metavar="FILE",
+                    help="gate this bench.py-style JSON record instead of "
+                         "running bench.py (synthetic; never written)")
+    ap.add_argument("--value", type=float,
+                    help="gate this synthetic value (with --platform/"
+                         "--size/--gens; never written)")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--gens", type=int, default=8)
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not append a BENCH_rNN.json for a real run")
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="bench.py subprocess timeout in seconds")
+    args = ap.parse_args(argv)
+
+    runs = load_history()
+    envelope = build_envelope(runs)
+    print(f"bench_gate: {len(runs)} historical run(s), "
+          f"{len(envelope)} config(s) in envelope")
+    for key in sorted(envelope):
+        slot = envelope[key]
+        print(f"  {key}: [{slot['lo']:.4g}, {slot['hi']:.4g}] "
+              f"from runs {slot['runs']}")
+    if args.dry_run:
+        return 0
+
+    synthetic = args.from_json is not None or args.value is not None
+    if args.from_json is not None:
+        with open(args.from_json) as f:
+            parsed = json.load(f)
+        record = {"cmd": f"--from-json {args.from_json}", "rc": 0,
+                  "tail": "", "parsed": parsed}
+    elif args.value is not None:
+        parsed = {"metric": "cell_updates_per_sec_single_chip",
+                  "value": args.value, "unit": "cells/s",
+                  "platform": args.platform, "size": args.size,
+                  "gens": args.gens}
+        record = {"cmd": f"--value {args.value}", "rc": 0, "tail": "",
+                  "parsed": parsed}
+    else:
+        record = run_bench(timeout_s=args.timeout)
+        parsed = record["parsed"]
+        if record["rc"] != 0:
+            print(f"bench_gate: bench.py exited {record['rc']}; tail:\n"
+                  f"{record['tail']}", file=sys.stderr)
+            return 1
+
+    ok, msg = gate(parsed, envelope, args.tolerance)
+    print(f"bench_gate: {msg}")
+    if not synthetic and not args.no_write:
+        n, path = next_run_path(runs)
+        record["n"] = n
+        with open(path, "w") as f:
+            json.dump(record, f)
+            f.write("\n")
+        print(f"bench_gate: wrote {path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

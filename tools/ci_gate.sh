@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# One-shot static gate: AST lint -> IR verify -> obs registry smoke.
+# One-shot static gate: AST lint -> IR verify -> obs registry smoke,
+# plus an opt-in bench-regression stage.
 #
-# All three stages share the exit-code contract (0 clean, 1 findings,
+# All stages share the exit-code contract (0 clean, 1 findings,
 # 2 internal error); the gate runs every stage even after a failure so
 # one CI invocation reports everything, then exits with the worst
 # status seen.  Usage:
@@ -9,6 +10,9 @@
 #   tools/ci_gate.sh                 # full gate (complete IR matrix)
 #   IR_ARGS=--fast tools/ci_gate.sh  # tier-1-sized IR subset
 #   LINT_ARGS=--changed-only tools/ci_gate.sh
+#   BENCH_GATE=1 tools/ci_gate.sh    # + bench envelope gate (hardware
+#                                    #   boxes; XLA:CPU runs --dry-run
+#                                    #   envelope-parse mode only)
 #
 set -u
 cd "$(dirname "$0")/.."
@@ -33,6 +37,23 @@ track $?
 note "obs registry smoke (tools/obs_smoke.py --lint-only)"
 python tools/obs_smoke.py --lint-only
 track $?
+
+# Off by default: a wall-clock gate belongs on boxes whose clock means
+# something.  BENCH_GATE=1 arms it; without TPU hardware it only parses
+# the historical envelope (--dry-run) so a slow CI runner cannot fail
+# the build on its own CPU.
+if [ "${BENCH_GATE:-0}" = "1" ]; then
+    if python -c 'import jax; import sys; sys.exit(0 if jax.devices()[0].platform == "tpu" else 1)' 2>/dev/null; then
+        note "bench regression gate (tools/bench_gate.py ${BENCH_GATE_ARGS:-})"
+        # shellcheck disable=SC2086
+        python tools/bench_gate.py ${BENCH_GATE_ARGS:-}
+    else
+        note "bench regression gate (tools/bench_gate.py --dry-run; no TPU)"
+        # shellcheck disable=SC2086
+        python tools/bench_gate.py --dry-run ${BENCH_GATE_ARGS:-}
+    fi
+    track $?
+fi
 
 note "result: exit $worst"
 exit "$worst"

@@ -6,9 +6,13 @@ on any schema drift — missing metric families (now including the ticket
 gauges), non-monotone histogram buckets, malformed trace records, a
 request whose lifecycle cannot be reconstructed by its shared request
 id, a missing async span kind (``enqueue``/``ticket_wait``/
-``unit_round``), a ticket that does not resolve exactly once, or a
+``unit_round``), a ticket that does not resolve exactly once, a
 sparse-engine session whose activity gauges (``mpi_tpu_active_tiles``/
-``mpi_tpu_active_fraction``) or ``sparse_step`` trace events drift.
+``mpi_tpu_active_fraction``) or ``sparse_step`` trace events drift, or
+a usage-ledger surface (``GET /usage``, the signature-labelled
+``mpi_tpu_usage_*`` families, ``mpi_tpu_cost_cards``,
+``mpi_tpu_roofline_efficiency``) that drifts from the describe rows or
+the scrape.
 
 This is the contract check for PR 4's tentpole: dashboards and trace
 tooling parse these two text formats, so their shape is API.  Run
@@ -347,6 +351,45 @@ def main():
             aio_srv.server_close()
             aio_thread.join(timeout=10)
 
+        # -- usage ledger + cost cards (PR 10) -------------------------
+        # every dispatch kind the traffic above exercised must have
+        # metered: solo steps, the coalesced batched pairs, the async
+        # unit chains, and the serial session's host path.  Placed after
+        # the last step so nothing dispatches between this read and the
+        # scrape below — the two surfaces must agree exactly.
+        code, body = call("GET", "/usage")
+        assert code == 200, f"/usage -> {code}"
+        usage = json.loads(body)
+        tot = usage["totals"]
+        if tot["syncs"] < 1 or tot["device_s"] <= 0:
+            raise ValueError(f"/usage metered nothing: {tot}")
+        for kind in ("solo", "batched", "unit", "host"):
+            if tot["by_kind"].get(kind, 0) < 1:
+                raise ValueError(f"/usage by_kind lacks a {kind} sync: "
+                                 f"{tot['by_kind']}")
+        sig_rows = usage["signatures"]
+        if not any(r.get("cost_cards") for r in sig_rows):
+            raise ValueError("no /usage signature row carries cost cards")
+        if not any("roofline" in r for r in sig_rows):
+            raise ValueError("no /usage signature row carries a roofline "
+                             "readout")
+        # ledger <-> describe consistency: a session's describe usage
+        # row IS its ledger row — one source of truth, exit 1 on drift
+        _, body = call("GET", "/stats")
+        stats_body = json.loads(body)
+        stats_sessions = {d["id"]: d for d in stats_body["sessions"]}
+        for usid, row in usage["sessions"].items():
+            d = stats_sessions.get(usid)
+            if d is None:
+                continue            # closed since (the ledger row stays)
+            if d.get("usage") != row:
+                raise ValueError(f"ledger/describe usage drift for "
+                                 f"{usid}: {d.get('usage')} != {row}")
+        if stats_body["obs"]["usage"]["syncs"] != tot["syncs"]:
+            raise ValueError(
+                f"/stats usage totals drifted from /usage: "
+                f"{stats_body['obs']['usage']['syncs']} != {tot['syncs']}")
+
         code, text = call("GET", "/metrics")   # final request; the counter
         assert code == 200, f"/metrics -> {code}"  # increments post-render
         types, samples = parse_prometheus(text)
@@ -384,11 +427,11 @@ def main():
                 f">= 1 after the stream smoke")
         http_total = sum(v for n, _, v in samples
                          if n == "mpi_tpu_http_requests_total")
-        # 28 requests precede the scrape, but the counter increments
+        # 30 requests precede the scrape, but the counter increments
         # after the response bytes go out, so the scrape may race the
         # increment of the request answered just before it
-        if http_total < 27:
-            raise ValueError(f"expected >= 27 http requests counted, "
+        if http_total < 29:
+            raise ValueError(f"expected >= 29 http requests counted, "
                              f"got {http_total}")
         # the ticket gauges are scrape-time reads over the dispatcher's
         # authoritative queue state: everything resolved, nothing queued
@@ -423,6 +466,47 @@ def main():
                     if n == "mpi_tpu_active_fraction")
         if not 0.0 <= frac <= 1.0:
             raise ValueError(f"active_fraction = {frac}, expected in [0, 1]")
+        # the usage families are signature-labelled (bounded
+        # cardinality: plan signatures, never sessions) and their
+        # scrape-time sums must match the /usage read above exactly —
+        # both render the same ledger and nothing dispatched in between
+        for fam in ("mpi_tpu_usage_device_seconds_total",
+                    "mpi_tpu_usage_syncs_total",
+                    "mpi_tpu_usage_generations_total",
+                    "mpi_tpu_usage_cells_total",
+                    "mpi_tpu_usage_flops_total"):
+            rows = [(labels, v) for n, labels, v in samples if n == fam]
+            if not rows:
+                raise ValueError(f"{fam} rendered no samples")
+            if any("sig" not in labels for labels, _ in rows):
+                raise ValueError(f"{fam} sample lacks its sig label")
+            if any("session" in labels for labels, _ in rows):
+                raise ValueError(f"{fam} is session-labelled — that "
+                                 f"cardinality belongs on /usage only")
+        dev_scrape = sum(v for n, _, v in samples
+                         if n == "mpi_tpu_usage_device_seconds_total")
+        if abs(dev_scrape - tot["device_s"]) > 1e-6 * max(tot["device_s"], 1):
+            raise ValueError(f"scrape device-seconds {dev_scrape} drifted "
+                             f"from /usage {tot['device_s']}")
+        syncs_scrape = sum(v for n, _, v in samples
+                           if n == "mpi_tpu_usage_syncs_total")
+        if syncs_scrape != tot["syncs"]:
+            raise ValueError(f"scrape syncs {syncs_scrape} != /usage "
+                             f"{tot['syncs']}")
+        cards_scrape = sum(v for n, _, v in samples
+                           if n == "mpi_tpu_cost_cards")
+        if cards_scrape < 2:        # at least the solo + batched misses
+            raise ValueError(f"mpi_tpu_cost_cards = {cards_scrape}, "
+                             f"expected >= 2 captured executables")
+        eff = [(labels, v) for n, labels, v in samples
+               if n == "mpi_tpu_roofline_efficiency"]
+        if not eff:
+            raise ValueError("mpi_tpu_roofline_efficiency rendered no "
+                             "samples after metered device dispatches")
+        for labels, v in eff:
+            if "sig" not in labels or not v > 0:
+                raise ValueError(f"roofline_efficiency sample malformed: "
+                                 f"{labels} = {v}")
     finally:
         server.shutdown()
         server.server_close()

@@ -51,39 +51,13 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
-import numpy as np
 
-# elementwise ALU primitives that occupy a VPU lane-op per output element
-ALU_PRIMS = {
-    "and", "or", "xor", "not", "add", "sub", "mul",
-    "shift_left", "shift_right_logical", "shift_right_arithmetic",
-    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "max", "min",
-    "population_count", "rem", "convert_element_type",
-}
-
-
-def _count_ops(jaxpr, consts_env=None) -> float:
-    """Total ALU lane-ops in a (closed) jaxpr, recursing into sub-jaxprs;
-    each primitive costs prod(shape of its first output)."""
-    total = 0.0
-    for eqn in jaxpr.eqns:
-        for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
-            sub = eqn.params.get(key)
-            if sub is not None:
-                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
-                total += _count_ops(inner)
-        if "branches" in eqn.params:
-            for br in eqn.params["branches"]:
-                total += _count_ops(br.jaxpr if hasattr(br, "jaxpr") else br)
-        if eqn.primitive.name in ALU_PRIMS:
-            aval = eqn.outvars[0].aval
-            total += float(np.prod(aval.shape)) if aval.shape else 1.0
-    return total
-
-
-def ops_per_cell(step_fn, example, cells: int) -> float:
-    closed = jax.make_jaxpr(step_fn)(example)
-    return _count_ops(closed.jaxpr) / cells
+# the counted-ops core now lives in the library (mpi_tpu/obs/opcount.py)
+# so the live service's cost cards can fall back to it; this tool keeps
+# the platform-pin dance above and re-exports the names it always had
+from mpi_tpu.obs.opcount import (  # noqa: F401 — re-exported API
+    ALU_PRIMS, _count_ops, ops_per_cell,
+)
 
 
 def measured_ops_per_cell() -> dict:

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""``top`` for the device-cost ledger: poll a serving box's ``GET
+/usage`` and render who is spending device time, on which compiled
+programs, and how close those programs run to the cost-model bound.
+
+Stdlib only (it talks to the same JSON surface the dashboards do):
+
+    python tools/usage_top.py --url localhost:8000
+    python tools/usage_top.py --url localhost:8000 --interval 2 --top 10
+    python tools/usage_top.py --url localhost:8000 --once   # one snapshot
+
+Exits 1 when the server answers 404 (``--no-obs`` — there is no ledger
+to watch) or stops answering.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch_usage(base: str, timeout_s: float = 10.0) -> dict:
+    with urllib.request.urlopen(base + "/usage", timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def _fmt_big(v: float) -> str:
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def render(usage: dict, top: int) -> str:
+    tot = usage["totals"]
+    lines = [
+        f"usage @ roof {_fmt_big(usage['roof_ops_per_s'])}ops/s — "
+        f"{tot['syncs']} syncs, device {_fmt_s(tot['device_s'])}, "
+        f"host {_fmt_s(tot['host_s'])}, {_fmt_big(tot['cells'])} cells, "
+        f"{_fmt_big(tot['flops'])} flops "
+        f"(by kind: {', '.join(f'{k}={v}' for k, v in tot['by_kind'].items() if v)})",
+        "",
+        f"{'signature':<48} {'syncs':>6} {'device':>9} {'cells/s':>9} "
+        f"{'eff':>7} cards",
+    ]
+    for row in usage["signatures"]:
+        roof = row.get("roofline") or {}
+        ach = roof.get("achieved_cells_per_s")
+        eff = roof.get("efficiency")
+        cards = row.get("cost_cards") or []
+        lines.append(
+            f"{row['signature']:<48} {row['syncs']:>6} "
+            f"{_fmt_s(row['device_s']):>9} "
+            f"{_fmt_big(ach) if ach else '-':>9} "
+            f"{f'{eff:.2%}' if eff is not None else '-':>7} "
+            f"{len(cards)} ({', '.join(sorted({c['source'] for c in cards})) or '-'})")
+    sessions = sorted(usage["sessions"].items(),
+                      key=lambda kv: kv[1]["device_s"] + kv[1]["host_s"],
+                      reverse=True)
+    lines += [
+        "",
+        f"{'session':<12} {'device':>9} {'host':>9} {'gens':>8} "
+        f"{'cells':>8} {'flops':>8} {'amort':>6} dispatches",
+    ]
+    for sid, row in sessions[:top]:
+        disp = ", ".join(f"{k}={v}" for k, v in row["dispatches"].items()
+                         if v) or "-"
+        lines.append(
+            f"{sid:<12} {_fmt_s(row['device_s']):>9} "
+            f"{_fmt_s(row['host_s']):>9} {row['generations']:>8} "
+            f"{_fmt_big(row['cells']):>8} {_fmt_big(row['flops']):>8} "
+            f"{row['mean_amortization']:>6.2f} {disp}")
+    if len(sessions) > top:
+        lines.append(f"... and {len(sessions) - top} more session(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="localhost:8000",
+                    help="serving box (host:port or full http URL)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll period in seconds (default 2)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="session rows to show (default 20)")
+    ap.add_argument("--once", action="store_true",
+                    help="one snapshot, no polling loop")
+    args = ap.parse_args(argv)
+    base = args.url if args.url.startswith("http") else f"http://{args.url}"
+    while True:
+        try:
+            usage = fetch_usage(base)
+        except urllib.error.HTTPError as e:
+            print(f"usage_top: {base}/usage -> {e.code} "
+                  f"({'--no-obs server has no ledger' if e.code == 404 else e.reason})",
+                  file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"usage_top: cannot reach {base}: {e}", file=sys.stderr)
+            return 1
+        if not args.once:
+            print("\x1b[2J\x1b[H", end="")     # clear, home
+        print(render(usage, args.top), flush=True)
+        if args.once:
+            return 0
+        time.sleep(max(0.2, args.interval))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1517,27 +1517,128 @@ def sparse_bench() -> None:
     print(json.dumps(out))
 
 
+def tune_bench() -> None:
+    """`python bench.py --tune`: the autotuner A/B + persistence proof
+    (ISSUE 11).
+
+    Runs :func:`mpi_tpu.tune.tune_plan` on the regime the plan space was
+    built for — a 2048^2 packed Life board with activity confined to
+    ~1% of 128^2 tiles (the deep-halo / sparse sweet spot) — persisting
+    the winner to ``perf/tune_cache.json``.  Then proves the serving
+    contract end to end: a SECOND process-state (fresh
+    :class:`~mpi_tpu.tune.TuneCache` reloaded from disk, fresh
+    ``SessionManager`` with ``tune_cache=``) must
+
+    * apply the persisted winner on its first compile miss,
+    * serve a second same-spec session from the EngineCache with ZERO
+      additional engine compiles, and
+    * produce a final board bit-identical to the default plan's.
+
+    Gates: tuned >= 1.3x default cells/s on at least one probed cell,
+    zero recompiles on the cache hit, bit-identity.  One JSON line.
+    """
+    out = {"bench": "tune", "ok": False}
+    try:
+        import numpy as np
+
+        from mpi_tpu.backends.tpu import build_engine
+        from mpi_tpu.config import GolConfig
+        from mpi_tpu.parallel.mesh import make_mesh
+        from mpi_tpu.serve.session import SessionManager
+        from mpi_tpu.tune import TuneCache, tune_plan
+
+        N, T, steps, reps, settle = 2048, 128, 200, 2, 32
+        config = GolConfig(rows=N, cols=N, steps=0, backend="tpu",
+                           mesh_shape=(1, 1))
+
+        # one blinker per active tile, clustered (same construction as
+        # --sparse): ~1% of tiles live, the regime sparse_tile wins
+        board = np.zeros((N, N), dtype=np.uint8)
+        ntiles = (N // T) ** 2
+        k = max(int(round(0.01 * ntiles)), 1)
+        side = int(np.ceil(np.sqrt(k)))
+        placed = 0
+        for i in range(side):
+            for j in range(side):
+                if placed >= k:
+                    break
+                r, c = i * T + T // 2, j * T + T // 2
+                board[r, c - 1:c + 2] = 1
+                placed += 1
+
+        cache = TuneCache()          # perf/tune_cache.json
+        res = tune_plan(config, board=board, steps=steps, reps=reps,
+                        settle=settle, cache=cache)
+        gate_speedup_ok = res.speedup >= 1.3 and bool(res.winner)
+
+        # -- second run: reload the cache from disk, serve through the
+        # manager, and hold the zero-recompile + bit-identity contract
+        mgr = SessionManager(batching=False, async_enabled=False,
+                             tune_cache=TuneCache(cache.path))
+        spec = {"rows": N, "cols": N, "backend": "tpu",
+                "mesh": [1, 1]}
+        s1 = mgr.create(spec)
+        mgr.write_board(s1["id"], board)
+        mgr.step(s1["id"], steps)
+        tuned_grid, _, _ = mgr.snapshot_array(s1["id"])
+        sess1 = mgr.get(s1["id"])
+        applied = dict(sess1.engine.tuned_plan or {})
+        compiles_after_first = sess1.engine.compile_count
+        s2 = mgr.create(spec)            # same signature: EngineCache hit
+        sess2 = mgr.get(s2["id"])
+        zero_recompile = (s2.get("cache_hit") is True
+                          and sess2.engine is sess1.engine
+                          and sess1.engine.compile_count
+                          == compiles_after_first)
+
+        default_eng = build_engine(config, mesh=make_mesh((1, 1)))
+        g = default_eng.step(default_eng.init_grid(initial=board), steps)
+        bit_identical = bool(np.array_equal(
+            tuned_grid, default_eng.fetch(g)))
+
+        out.update(
+            ok=bool(gate_speedup_ok and zero_recompile and bit_identical),
+            rows=N, cols=N, steps=steps,
+            winner=res.winner, winner_label=res.winner_label,
+            default_cells_per_s=round(res.default_cells_per_s),
+            tuned_cells_per_s=round(res.tuned_cells_per_s),
+            speedup=round(res.speedup, 3),
+            probed=sum(1 for p in res.probes if p.status == "measured"),
+            pruned=res.pruned,
+            key=res.key,
+            cache_path=cache.path,
+            applied_on_reload=applied,
+            gate_speedup_ok=gate_speedup_ok,
+            gate_zero_recompile_ok=zero_recompile,
+            gate_bit_identical_ok=bit_identical,
+        )
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
+# mode registry: one row per `bench.py --<mode>`.  Each handler takes
+# the argv tail after the mode flag; anything unknown (or no flag at
+# all) falls through to main(), the full ladder.
+MODES = {
+    "--probe": lambda argv: probe(),
+    "--serve": lambda argv: serve_bench(),
+    "--serve-batched": lambda argv: serve_bench_batched(),
+    "--serve-async": lambda argv: serve_bench_async(),
+    "--serve-recovery": lambda argv: serve_bench_recovery(),
+    "--serve-obs": lambda argv: serve_bench_obs(),
+    "--serve-wire": lambda argv: serve_bench_wire(),
+    "--sparse": lambda argv: sparse_bench(),
+    "--tune": lambda argv: tune_bench(),
+    "--child": lambda argv: child(*(int(a) for a in argv[:3])),
+    "--mesh-child": lambda argv: mesh_child(*(int(a) for a in argv[:5])),
+}
+
+
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
-        probe()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
-        serve_bench()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-batched":
-        serve_bench_batched()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-async":
-        serve_bench_async()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-recovery":
-        serve_bench_recovery()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-obs":
-        serve_bench_obs()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--serve-wire":
-        serve_bench_wire()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--sparse":
-        sparse_bench()
-    elif len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
-    elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":
-        mesh_child(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
-                   int(sys.argv[5]), int(sys.argv[6]))
+    mode = sys.argv[1] if len(sys.argv) > 1 else None
+    handler = MODES.get(mode)
+    if handler is not None:
+        handler(sys.argv[2:])
     else:
         main()

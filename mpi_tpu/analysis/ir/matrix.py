@@ -83,6 +83,10 @@ CELLS: List[Cell] = [
          tier="fast"),
     Cell("packed_k2_1x2", 64, 64, mesh=(1, 2), comm_every=2, depth=3,
          tier="fast"),
+    # radius-2 deep halo: ir-collective holds the widened slab depths
+    # {2, 4} the tuner's comm_every>1 winners rely on (ISSUE 11)
+    Cell("ltl_r2_k2_1x2", 64, 64, rule=_R2, mesh=(1, 2), comm_every=2,
+         depth=3, tier="fast"),
     Cell("seam_1x1", 64, 48, depth=2, tier="fast"),
     Cell("ltl_r2_1x2_dead", 64, 64, rule=_R2, boundary="dead", mesh=(1, 2),
          depth=1, tier="fast"),

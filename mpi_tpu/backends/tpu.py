@@ -157,7 +157,8 @@ def _shard_shape_packed(config: GolConfig, mesh, cols=None):
 
 def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int,
                         cols=None, pad_bits: int = 0, depths=None,
-                        seam_pad: bool = False, overlap=None):
+                        seam_pad: bool = False, overlap=None,
+                        blocks=None):
     """(stepper, used_pallas) for the packed engine: on a single device
     the fused Pallas SWAR kernel (ops/pallas_bitlife.py) replaces the
     shard_map/XLA path — no halo exchange exists, ``comm_every`` becomes
@@ -188,7 +189,9 @@ def _pick_packed_evolve(config: GolConfig, mesh, n_devices: int,
         # (birth-on-0 with gens > 1 is already rejected by GolConfig)
         if use and supports(shape, config.rule, gens=gens):
             return make_pallas_bit_stepper(
-                config.rule, config.boundary, interpret=interpret, gens=gens
+                config.rule, config.boundary, interpret=interpret,
+                gens=gens,
+                blocks=tuple(blocks) if blocks is not None else None,
             ), True
     stepper = make_sharded_bit_stepper(
         mesh, config.rule, config.boundary,
@@ -447,6 +450,12 @@ class Engine:
         # installed — obs=None engines never pay the analysis/retrace
         self.sig_label = None
         self._cost_cards = {}
+        # autotuner provenance (mpi_tpu/tune): the applied plan-override
+        # dict when build_engine resolved this engine through a tune
+        # cache, None on the default build path.  Read by the obs layer
+        # (mpi_tpu_tuned_plans, the plan="tuned" dispatch series) and
+        # /stats describe rows; never consulted by the step path.
+        self.tuned_plan = None
 
     @property
     def col_limit(self):
@@ -875,7 +884,8 @@ class Engine:
         return [np.asarray(b) for b in final]
 
 
-def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:
+def build_engine(config: GolConfig, mesh=None, depths=None, tune=None,
+                 blocks=None) -> Engine:
     """Resolve the full plan for ``config`` — mesh, pad-to-32 width,
     engine dispatch, seam wrapping, overlap feasibility — and return an
     :class:`Engine` holding the (uncompiled) stepper.
@@ -889,16 +899,31 @@ def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:
     ``depths``: the local-step depths that will actually be traced
     (``run_tpu`` passes the exact segment plan via ``segment_depths``);
     None uses the conservative 1..comm_every superset — right for
-    persistent engines, which step by arbitrary k."""
+    persistent engines, which step by arbitrary k.
+
+    ``tune``: an opt-in :class:`~mpi_tpu.tune.TuneCache` (or path) — a
+    persisted autotuner winner for this exact (platform, requested
+    plan) replaces the requested knobs before planning; the default
+    ``None`` never reads the cache, so untuned builds are byte-for-byte
+    the pre-tuner program.  ``blocks`` force-overrides the fused SWAR
+    kernel's (BM, CM) block pick (the tuner probes candidates with it;
+    a cached winner's ``blocks`` entry arrives through ``tune``)."""
     import sys
 
     mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
     from mpi_tpu.config import ConfigError, validate_mesh
     from mpi_tpu.parallel.mesh import AXES
 
+    mi, mj = mesh.shape[AXES[0]], mesh.shape[AXES[1]]
+    tuned_plan = None
+    if tune is not None:
+        from mpi_tpu.tune import resolve_tuned
+
+        config, tuned_plan = resolve_tuned(config, (mi, mj), tune)
+        if tuned_plan is not None and blocks is None:
+            blocks = tuned_plan.get("blocks")
     # Auto-chosen meshes must pass the same compatibility checks as
     # explicit --mesh shapes (fail fast, not deep in shard_map).
-    mi, mj = mesh.shape[AXES[0]], mesh.shape[AXES[1]]
     validate_mesh(
         config.rows, config.cols, (mi, mj),
         config.rule.radius * config.comm_every,
@@ -1052,6 +1077,7 @@ def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:
             evolve, used_pallas = _pick_packed_evolve(
                 config, mesh, mi * mj, cols=cols_eff, pad_bits=pad_bits,
                 depths=depths, seam_pad=seam, overlap=overlap_eff,
+                blocks=blocks,
             )
     else:
         evolve, used_pallas = _pick_dense_evolve(config, mesh, mi * mj)
@@ -1138,12 +1164,17 @@ def build_engine(config: GolConfig, mesh=None, depths=None) -> Engine:
             return activity.make_sparse_evolve(
                 _base_fallback(), sparse_local, sparse_plan)
 
-    return Engine(
+    if tuned_plan is not None:
+        _note(f"autotuned plan applied: {tuned_plan} "
+              f"(tune cache winner for this signature)")
+    engine = Engine(
         config, mesh, evolve, bitpacked=packed_mode or bool(ltl_mode),
         cols_eff=cols_eff, pad_bits=pad_bits, used_pallas=used_pallas,
         fallback_factory=fallback_factory, notes=notes,
         sparse_plan=sparse_plan,
     )
+    engine.tuned_plan = tuned_plan
+    return engine
 
 
 def run_tpu(

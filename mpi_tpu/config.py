@@ -205,6 +205,40 @@ SIGNATURE_FIELDS = (
 )
 
 
+# Config fields the autotuner (mpi_tpu/tune/) may override when applying
+# a cached winner.  Deliberately narrow: every field here re-validates
+# through GolConfig's __post_init__ on application, and none of them
+# changes the *semantics* of the run (comm_every and sparse_tile are
+# bit-identical execution strategies; the parity bless in the tuner
+# holds them to that).  Plan entries may additionally carry the
+# non-config knobs in PLAN_ONLY_KEYS (kernel block shape, serving batch
+# hint) which never reach GolConfig.
+TUNABLE_FIELDS = ("comm_every", "sparse_tile")
+PLAN_ONLY_KEYS = ("blocks", "batch")
+
+
+def apply_plan(config: GolConfig, plan: dict) -> GolConfig:
+    """``config`` with a tune-cache plan's overrides applied.
+
+    Unknown keys raise :class:`ConfigError` (a cache written by a newer
+    tuner must fail loudly, not half-apply); the replaced config re-runs
+    full validation, so a stale plan that no longer satisfies current
+    rules raises too — callers on the serving path catch and fall back
+    to the untuned plan, ``python -m mpi_tpu.tune --check`` reports it."""
+    import dataclasses
+
+    bad = [k for k in plan if k not in TUNABLE_FIELDS + PLAN_ONLY_KEYS]
+    if bad:
+        raise ConfigError(
+            f"tune plan carries unknown override(s) {sorted(bad)} "
+            f"(tunable: {list(TUNABLE_FIELDS)}, "
+            f"plan-only: {list(PLAN_ONLY_KEYS)})")
+    overrides = {k: plan[k] for k in TUNABLE_FIELDS if k in plan}
+    if not overrides:
+        return config
+    return dataclasses.replace(config, **overrides)
+
+
 def plan_segments(steps: int, snapshot_every: int) -> List[int]:
     """Split `steps` into evolution-segment lengths between snapshot points
     (shared by every backend so their snapshot series always align)."""

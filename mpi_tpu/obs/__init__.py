@@ -111,6 +111,13 @@ class Obs:
         self.dispatch_solo = self.dispatch_latency.series(mode="solo")
         self.dispatch_batched = self.dispatch_latency.series(mode="batched")
         self.dispatch_host = self.dispatch_latency.series(mode="host")
+        # tuned-plan dispatches observe through their own series (an
+        # added plan="tuned" label): the existing three keep their exact
+        # label sets, so dashboards and tests keyed on them never move
+        self.dispatch_solo_tuned = self.dispatch_latency.series(
+            mode="solo", plan="tuned")
+        self.dispatch_batched_tuned = self.dispatch_latency.series(
+            mode="batched", plan="tuned")
         self.occupancy_series = self.batch_occupancy.series()
         self.lock_wait_series = self.lock_wait.series()
         for fmt in ("json", "binary"):
@@ -301,6 +308,18 @@ class Obs:
         m.gauge_fn("mpi_tpu_cost_cards",
                    "Captured executable cost cards by capture source",
                    _cost_card_counts)
+
+        def _tuned_plans():
+            counts = {"tuned": 0, "default": 0}
+            for e in _live_engines(manager):
+                k = "tuned" if getattr(e, "tuned_plan", None) else "default"
+                counts[k] += 1
+            return [({"plan": k}, v) for k, v in counts.items()]
+
+        m.gauge_fn("mpi_tpu_tuned_plans",
+                   "Live engines by plan provenance (tune-cache winner "
+                   "applied vs default build)",
+                   _tuned_plans)
 
         def _roofline_efficiency():
             # achieved cells/s (ledger) over the cost-model bound (the

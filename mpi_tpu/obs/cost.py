@@ -79,19 +79,32 @@ class CostCard:
         return asdict(self)
 
 
-def ops_per_cell_estimate(cards, cells: int):
-    """The per-cell-update op estimate for one signature, from its
-    captured cards.  Depth-1 executables are preferred: XLA:CPU's
+def ops_per_cell_detail(cards, cells: int):
+    """``(estimate, trip_count_suspect)`` for one signature's captured
+    cards.  Depth-1 executables are preferred: XLA:CPU's
     ``cost_analysis`` counts a while-loop body ONCE, so depth>1
     programs under-report by their trip count; the depth-1 program has
-    no loop to miscount.  Falls back to the min over whatever was
-    reported; ``None`` when no card carries flops."""
+    no loop to miscount.  When only depth>1 cards carry flops the min
+    is still returned — but flagged ``trip_count_suspect=True`` instead
+    of silently under-reporting (the opcount fallback recurses into
+    loop bodies without multiplying by trip count either, so the flag
+    applies to both sources).  ``(None, False)`` when no card carries
+    flops."""
     vals = [c.ops_per_cell(cells) for c in cards if c.flops > 0]
     depth1 = [c.ops_per_cell(cells) for c in cards
               if c.flops > 0 and c.depth == 1]
     if depth1:
-        return min(depth1)
-    return min(vals) if vals else None
+        return min(depth1), False
+    if vals:
+        return min(vals), True
+    return None, False
+
+
+def ops_per_cell_estimate(cards, cells: int):
+    """The bare estimate (see :func:`ops_per_cell_detail`; callers that
+    must distinguish a trip-count-suspect depth>1-only estimate use the
+    detail form — ``/usage`` surfaces the flag)."""
+    return ops_per_cell_detail(cards, cells)[0]
 
 
 def _first_analysis(compiled):

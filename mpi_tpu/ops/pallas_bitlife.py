@@ -364,15 +364,20 @@ def make_pallas_bit_stepper(
     boundary: str = "periodic",
     interpret: bool = False,
     gens: int = 1,
+    blocks: tuple[int, int] | None = None,
 ):
     """evolve(packed, steps) on packed uint32 grids, running ``gens``
     generations per kernel pass (temporal blocking); jitted with donated
-    input, so ``evolve.lower`` works for ahead-of-time compilation."""
+    input, so ``evolve.lower`` works for ahead-of-time compilation.
+    ``blocks`` overrides the auto-picked (BM, CM) per pass — the
+    autotuner's block-shape knob (a bad override fails at compile and
+    takes the engine's XLA fallback, never a wrong answer)."""
     from mpi_tpu.utils.segmenting import segmented_evolve
 
     def make_local(k):
         def local(p):
-            return pallas_bit_step(p, rule, boundary, interpret=interpret, gens=k)
+            return pallas_bit_step(p, rule, boundary, interpret=interpret,
+                                   gens=k, blocks=blocks)
 
         return local
 

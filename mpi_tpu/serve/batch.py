@@ -276,7 +276,10 @@ class MicroBatcher:
                       sids=[e.session.id for e in group],
                       request_ids=[e.rid for e in group])
             obs.occupancy_series.observe(B)
-            obs.dispatch_batched.observe(t2 - t1)
+            if getattr(engine, "tuned_plan", None):
+                obs.dispatch_batched_tuned.observe(t2 - t1)
+            else:
+                obs.dispatch_batched.observe(t2 - t1)
             # usage ledger: ONE sync split evenly across the B riders
             # (shares sum to the leader's block time); the failed-batch
             # path above commits nothing here — each solo fallback

@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic fault plan at the engine dispatch "
                    "boundary, e.g. 'step:3:raise' or 'any:2:hang:5' "
                    "(testing; env fallback MPI_TPU_FAULTS)")
+    p.add_argument("--tune-cache", default=None, metavar="PATH",
+                   help="apply autotuned plan winners from this tune "
+                   "cache on every engine compile miss (see 'python -m "
+                   "mpi_tpu.tune'); 'auto' resolves to "
+                   "<state-dir>/tune_cache.json when --state-dir is set, "
+                   "else the repo default perf/tune_cache.json.  Unset: "
+                   "no cache is read, plans build exactly as requested")
     p.add_argument("--no-obs", action="store_true",
                    help="disable tracing/metrics entirely: /metrics answers "
                    "404 and the step path runs uninstrumented "
@@ -143,6 +150,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 
         obs = Obs(trace_capacity=args.trace_capacity,
                   trace_log=args.trace_log)
+    tune_cache = args.tune_cache
+    if tune_cache == "auto":
+        from mpi_tpu.tune import default_cache_path
+
+        tune_cache = (os.path.join(args.state_dir, "tune_cache.json")
+                      if args.state_dir else default_cache_path())
     try:
         manager = SessionManager(
             EngineCache(max_size=args.cache_size,
@@ -162,6 +175,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             degrade=not args.no_degrade,
             faults=faults,
             obs=obs,
+            tune_cache=tune_cache,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -191,6 +205,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             extras.append(f"restored {manager.restored_sessions}")
     if faults:
         extras.append(f"faults '{faults}'")
+    if tune_cache:
+        extras.append(f"tune-cache {tune_cache}")
     if args.no_obs:
         extras.append("obs off")
     elif args.trace_log:

@@ -321,8 +321,17 @@ class SessionManager:
                  retry_backoff_s: float = 0.05,
                  degrade: bool = True,
                  faults=None,
-                 obs=None):
+                 obs=None,
+                 tune_cache=None):
         self.obs = obs                  # mpi_tpu.obs.Obs or None (off)
+        # autotuned-plan application is OPT-IN: a TuneCache (or a path to
+        # one) makes every tpu create consult the cache on compile miss;
+        # None (the default) leaves the build path byte-identical
+        if isinstance(tune_cache, str):
+            from mpi_tpu.tune import TuneCache
+
+            tune_cache = TuneCache(tune_cache)
+        self.tune_cache = tune_cache
         self.cache = cache if cache is not None else EngineCache()
         self.batcher = (
             MicroBatcher(window_ms=batch_window_ms, max_batch=batch_max)
@@ -417,8 +426,12 @@ class SessionManager:
             session = self._degraded_host_session(config, initial=initial)
             session.plan_sig = sig
             return session
+        # the tune cache rides the existing compile-miss seam: the
+        # signature is the REQUESTED plan's, so a cached winner costs
+        # zero extra recompiles — hit sessions share the tuned engine
         engine, hit = self.cache.get_or_build(
-            sig, lambda: build_engine(config, mesh=make_mesh(mesh_shape)))
+            sig, lambda: build_engine(config, mesh=make_mesh(mesh_shape),
+                                      tune=self.tune_cache))
         if self.faults is not None:
             # idempotent: cached engines get the same hook re-installed
             engine.fault_hook = self.faults.engine_hook
@@ -872,7 +885,10 @@ class SessionManager:
                     obs.event("device_dispatch", t2 - t1, t1,
                               sid=session.id, steps=steps,
                               block_s=round(t2 - td, 9))
-                obs.dispatch_solo.observe(t2 - t1)
+                if getattr(session.engine, "tuned_plan", None):
+                    obs.dispatch_solo_tuned.observe(t2 - t1)
+                else:
+                    obs.dispatch_solo.observe(t2 - t1)
                 # usage ledger: one committed sync.  The unit path is an
                 # async solo chain (ONE block for `steps` depth-1
                 # executions); its FLOPs are the depth-1 card times the
@@ -1111,6 +1127,8 @@ class SessionManager:
                 d["batched_steps"] = session.batched_steps
                 if engine.sparse_plan is not None:
                     d["sparse"] = engine.sparse_stats(session.grid)
+                if getattr(engine, "tuned_plan", None):
+                    d["tuned_plan"] = dict(engine.tuned_plan)
             if session.degraded:
                 d["degraded"] = True
                 d["degraded_reason"] = session.degraded_reason
@@ -1184,7 +1202,7 @@ class SessionManager:
         checkpoint) starts metering from zero, by design."""
         if self.obs is None:
             raise RuntimeError("usage metering needs observability")
-        from mpi_tpu.obs.cost import ops_per_cell_estimate, roof_ops_per_s
+        from mpi_tpu.obs.cost import ops_per_cell_detail, roof_ops_per_s
         from mpi_tpu.obs.profile import _live_engines
 
         roof = roof_ops_per_s()
@@ -1202,8 +1220,10 @@ class SessionManager:
             if eng is not None:
                 cards = eng.cost_cards()
                 row["cost_cards"] = [c.as_dict() for c in cards]
-                ops_per_cell = ops_per_cell_estimate(cards,
-                                                     eng.config.cells)
+                ops_per_cell, suspect = ops_per_cell_detail(
+                    cards, eng.config.cells)
+                if getattr(eng, "tuned_plan", None):
+                    row["tuned_plan"] = dict(eng.tuned_plan)
                 if ops_per_cell is not None and row["device_s"] > 0:
                     bound = roof / ops_per_cell
                     achieved = row["cells"] / row["device_s"]
@@ -1212,6 +1232,10 @@ class SessionManager:
                         "bound_cells_per_s": bound,
                         "achieved_cells_per_s": achieved,
                         "efficiency": achieved / bound,
+                        # only depth>1 cards carried flops: XLA counts a
+                        # while-loop body once, so the estimate may be
+                        # low by up to the trip count
+                        "trip_count_suspect": suspect,
                     }
             sig_rows.append(row)
         return {

@@ -1,0 +1,50 @@
+"""``mpi_tpu.tune`` — the cost-card-guided plan autotuner (ISSUE 11).
+
+The plan knobs (halo cadence ``comm_every``, sparse tile ``T``, Pallas
+block shape, serving batch ``B``) have shipped with hand-picked defaults
+since they landed; this package searches them with real timed probes,
+prunes by the op-count cost model, blesses winners against the parity
+oracle and the halo-depth IR contract, and persists them per (platform,
+requested plan signature) in a JSON cache — consulted by
+``build_engine(tune=...)`` and the serving ``EngineCache`` path so a
+tuned plan applies to the one-shot CLI and live sessions alike with
+zero extra recompiles on the second run.
+
+Application is strictly OPT-IN (a ``tune=`` argument, the serve CLI's
+``--tune-cache``, or ``bench.py --tune``): the default build path never
+reads the cache, so IR baselines, ``--no-obs`` bit-identity, and every
+existing test see exactly the pre-tuner program.
+
+    python -m mpi_tpu.tune --rows 2048 --cols 2048        # tune one plan
+    python -m mpi_tpu.tune --check                        # CI staleness gate
+    python bench.py --tune                                # A/B + persistence
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+from mpi_tpu.config import GolConfig
+from mpi_tpu.tune.cache import (
+    TuneCache, default_cache_path, platform_fingerprint, tune_key,
+)
+from mpi_tpu.tune.space import Candidate, candidates
+from mpi_tpu.tune.tuner import TuneResult, should_prune, tune_plan
+
+__all__ = [
+    "TuneCache", "TuneResult", "Candidate", "candidates",
+    "default_cache_path", "platform_fingerprint", "resolve_tuned",
+    "should_prune", "tune_key", "tune_plan",
+]
+
+
+def resolve_tuned(config: GolConfig, mesh_shape: Tuple[int, int],
+                  tune: Union[TuneCache, str, None],
+                  ) -> Tuple[GolConfig, Optional[dict]]:
+    """(possibly-tuned config, applied plan or None) — the one seam
+    ``build_engine`` calls.  ``tune`` may be a :class:`TuneCache`, a
+    cache path, or None (untouched)."""
+    if tune is None:
+        return config, None
+    cache = tune if isinstance(tune, TuneCache) else TuneCache(str(tune))
+    return cache.resolve(config, mesh_shape)

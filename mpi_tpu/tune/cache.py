@@ -1,0 +1,281 @@
+"""The JSON tune cache: persisted autotuner winners keyed by plan.
+
+One entry per (platform fingerprint, requested plan signature): the
+*requested* signature, not the winner's — a user who explicitly asks for
+``comm_every=4`` has a different key than one who took the defaults, so
+explicit choices are never silently overridden; the tuner only rewrites
+plans it was asked to tune.  The key deliberately drops the signature's
+``segments`` field (snapshot cadence changes which depths compile, not
+which plan wins) and canonicalizes the rule to its parseable string form
+(``B3/S23`` / ``R2,B8-12,S9-14``) so semantically equal rules share one
+winner regardless of their registry name.
+
+Entries store a reconstructable ``base`` config dict, the winning
+``plan`` override dict (``{}`` = the default plan won — still worth
+persisting: the second run knows tuning already happened), and the
+measured A/B stats.  The file is advisory state, never load-bearing: a
+corrupt or missing file reads as an empty cache, a stale plan that no
+longer validates under current :mod:`mpi_tpu.config` rules is skipped at
+resolve time (and reported by ``python -m mpi_tpu.tune --check``).
+
+Invalidation: the cache key embeds ``len(SIGNATURE_FIELDS)`` as a
+schema version, so the MIGRATION.md signature-extension procedure
+(add field → SIGNATURE_FIELDS → regenerate IR baseline) automatically
+orphans every cached winner — re-run the tuner after extending the
+signature (see MIGRATION.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from mpi_tpu.config import (
+    ConfigError, GolConfig, SIGNATURE_FIELDS, apply_plan, validate_mesh,
+)
+from mpi_tpu.models.rules import Rule, rule_from_name
+
+FORMAT_VERSION = 1
+
+
+def default_cache_path() -> str:
+    """``perf/tune_cache.json`` at the repo root (next to the bench
+    artifacts), unless ``MPI_TPU_TUNE_CACHE`` points elsewhere."""
+    env = os.environ.get("MPI_TPU_TUNE_CACHE")
+    if env:
+        return env
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "perf", "tune_cache.json")
+
+
+def platform_fingerprint() -> str:
+    """``platform:device_kind:count`` of the devices this process would
+    compile for — the hardware half of the tune key (a CPU winner must
+    never apply to a TPU run and vice versa)."""
+    import jax
+
+    devs = jax.devices()
+    d = devs[0]
+    kind = (getattr(d, "device_kind", "") or "unknown").replace(" ", "_")
+    return f"{d.platform}:{kind}:{len(devs)}"
+
+
+def rule_canonical(rule: Rule) -> str:
+    """A canonical rule string ``rule_from_name`` can reparse: the name
+    is dropped (``life`` and ``B3/S23`` share one winner — tuning
+    depends on semantics, not labels)."""
+    if rule.radius == 1:
+        b = "".join(str(c) for c in sorted(rule.birth))
+        s = "".join(str(c) for c in sorted(rule.survive))
+        return f"B{b}/S{s}"
+
+    def ranges(counts) -> str:
+        from mpi_tpu.models.rules import _intervals
+
+        return "+".join(f"{lo}-{hi}" if lo != hi else str(lo)
+                        for lo, hi in _intervals(counts))
+
+    return (f"R{rule.radius},B{ranges(rule.birth)},"
+            f"S{ranges(rule.survive)}")
+
+
+def base_dict(config: GolConfig, mesh_shape: Tuple[int, int]) -> dict:
+    """The reconstructable request-plan fields of one entry — the
+    signature minus ``segments``, with the rule canonicalized."""
+    return {
+        "rows": config.rows,
+        "cols": config.cols,
+        "rule": rule_canonical(config.rule),
+        "boundary": config.boundary,
+        "backend": config.backend,
+        "mesh": [int(mesh_shape[0]), int(mesh_shape[1])],
+        "comm_every": config.comm_every,
+        "overlap": bool(config.overlap),
+        "sparse_tile": config.sparse_tile,
+    }
+
+
+def config_from_base(base: dict) -> Tuple[GolConfig, Tuple[int, int]]:
+    """Rebuild (config, mesh_shape) from an entry's ``base`` dict —
+    re-running every current validation rule (the ``--check`` path)."""
+    mesh = tuple(int(x) for x in base["mesh"])
+    cfg = GolConfig(
+        rows=int(base["rows"]), cols=int(base["cols"]), steps=0,
+        rule=rule_from_name(str(base["rule"])),
+        boundary=str(base["boundary"]), backend=str(base["backend"]),
+        mesh_shape=mesh, comm_every=int(base.get("comm_every", 1)),
+        overlap=bool(base.get("overlap", False)),
+        sparse_tile=int(base.get("sparse_tile", 0)),
+    )
+    return cfg, mesh
+
+
+def tune_key(config: GolConfig, mesh_shape: Tuple[int, int],
+             platform: Optional[str] = None) -> str:
+    """The cache key for a *requested* config on a platform.  Embeds the
+    signature arity as a schema tag: extending ``SIGNATURE_FIELDS``
+    orphans (never mis-applies) every existing entry."""
+    platform = platform if platform is not None else platform_fingerprint()
+    b = base_dict(config, mesh_shape)
+    return "|".join([
+        f"sig{len(SIGNATURE_FIELDS)}", platform,
+        f"{b['rows']}x{b['cols']}", b["rule"], b["boundary"], b["backend"],
+        f"mesh{b['mesh'][0]}x{b['mesh'][1]}",
+        f"k{b['comm_every']}", f"ov{int(b['overlap'])}",
+        f"T{b['sparse_tile']}",
+    ])
+
+
+class TuneCache:
+    """Thread-safe load/store of tune entries in one JSON file.
+
+    A missing, unreadable, or corrupt file is an EMPTY cache (noted on
+    ``load_error``), never an exception — serving must not die on bad
+    advisory state.  Writes go tmp+fsync+replace (the recovery store's
+    discipline) so a crash mid-save cannot corrupt a good file."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path if path is not None else default_cache_path()
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+        self.load_error: Optional[str] = None
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as fh:
+                raw = json.load(fh)
+            entries = raw.get("entries")
+            if not isinstance(entries, dict):
+                raise ValueError("no 'entries' object")
+            self._entries = {str(k): dict(v) for k, v in entries.items()
+                             if isinstance(v, dict)}
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 — corrupt cache = empty cache
+            self.load_error = f"{type(e).__name__}: {e}"
+            self._entries = {}
+
+    def save(self) -> None:
+        with self._lock:
+            payload = {"version": FORMAT_VERSION,
+                       "entries": dict(sorted(self._entries.items()))}
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".tune_cache.",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(payload, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def entries(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._entries)
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            e = self._entries.get(key)
+            return dict(e) if e is not None else None
+
+    def record(self, config: GolConfig, mesh_shape: Tuple[int, int],
+               plan: dict, measured: dict,
+               platform: Optional[str] = None) -> str:
+        """Store one blessed winner (call :meth:`save` to persist)."""
+        platform = (platform if platform is not None
+                    else platform_fingerprint())
+        key = tune_key(config, mesh_shape, platform)
+        entry = {
+            "platform": platform,
+            "base": base_dict(config, mesh_shape),
+            "plan": dict(plan),
+            "measured": dict(measured),
+        }
+        with self._lock:
+            self._entries[key] = entry
+        return key
+
+    # -- serving-path resolution ------------------------------------------
+
+    def resolve(self, config: GolConfig, mesh_shape: Tuple[int, int],
+                platform: Optional[str] = None,
+                ) -> Tuple[GolConfig, Optional[dict]]:
+        """(possibly-tuned config, applied plan dict or None).
+
+        Best-effort by contract: no entry, an empty winning plan, or a
+        stale plan that fails current validation all return the config
+        untouched — a bad cache can cost the speedup, never the run."""
+        try:
+            key = tune_key(config, mesh_shape, platform)
+        except Exception:  # noqa: BLE001 — advisory state, never fatal
+            return config, None
+        entry = self.get(key)
+        if not entry:
+            return config, None
+        plan = entry.get("plan") or {}
+        if not plan:
+            return config, None
+        try:
+            tuned = apply_plan(config, plan)
+            validate_mesh(tuned.rows, tuned.cols, tuple(mesh_shape),
+                          tuned.rule.radius * tuned.comm_every)
+        except ConfigError:
+            return config, None
+        return tuned, dict(plan)
+
+    # -- staleness / validity audit ---------------------------------------
+
+    def check(self) -> List[str]:
+        """Findings for ``python -m mpi_tpu.tune --check``: every entry's
+        base must reconstruct under current config rules, its key must
+        still resolve (recompute to itself — signature arity drift
+        orphans it), and its plan must still apply cleanly."""
+        findings: List[str] = []
+        if self.load_error is not None:
+            findings.append(f"cache file {self.path}: unreadable "
+                            f"({self.load_error}) — treated as empty")
+        for key, entry in sorted(self.entries().items()):
+            base = entry.get("base")
+            if not isinstance(base, dict):
+                findings.append(f"entry {key}: no base config dict")
+                continue
+            try:
+                cfg, mesh = config_from_base(base)
+            except Exception as e:  # noqa: BLE001 — each entry judged alone
+                findings.append(
+                    f"entry {key}: base config no longer validates "
+                    f"({type(e).__name__}: {e})")
+                continue
+            expect = tune_key(cfg, mesh, str(entry.get("platform", "")))
+            if expect != key:
+                findings.append(
+                    f"entry {key}: signature no longer resolves "
+                    f"(recomputes to {expect}; SIGNATURE_FIELDS arity or "
+                    f"key schema changed — re-run the tuner)")
+            plan = entry.get("plan") or {}
+            try:
+                tuned = apply_plan(cfg, plan)
+                validate_mesh(tuned.rows, tuned.cols, mesh,
+                              tuned.rule.radius * tuned.comm_every)
+            except ConfigError as e:
+                findings.append(
+                    f"entry {key}: plan {plan} no longer validates "
+                    f"under current config rules ({e})")
+        return findings

@@ -1,0 +1,118 @@
+"""Candidate plan generation for the autotuner.
+
+The search space is the deep-halo / fused-generation trade (ROADMAP
+item 3) over the knobs that already exist:
+
+* ``comm_every`` k — generations per halo exchange / temporal-blocking
+  depth (ghost ring widens to k·r; ``expected_slab_depths`` encodes the
+  contract the ir-collective check verifies);
+* ``sparse_tile`` T — the activity-gated engine's dirty-tile size;
+* ``blocks`` (BM, CM) — the fused Pallas SWAR kernel's DMA-slab /
+  compute-tile rows (single-device packed TPU runs only);
+* ``batch`` B — a serving hint for the microbatcher, probed but never
+  applied to the solo program.
+
+Feasibility is judged by the SAME validation the production path runs
+(:func:`mpi_tpu.config.apply_plan` → ``GolConfig.__post_init__`` →
+``validate_mesh``): the space enumerates, config rules decide.  The
+default plan is always candidate 0 — it is the incumbent every bound is
+measured against, and the parity oracle every winner must match
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Tuple
+
+from mpi_tpu.config import ConfigError, GolConfig, apply_plan, validate_mesh
+
+COMM_EVERY_CANDIDATES = (2, 4, 8)
+SPARSE_TILE_CANDIDATES = (32, 64, 128, 256)
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point of the plan space: the override dict (empty = the
+    default plan) plus a display label."""
+
+    plan: dict = field(default_factory=dict)
+    label: str = "default"
+
+    @property
+    def is_default(self) -> bool:
+        return not self.plan
+
+    @property
+    def data_dependent(self) -> bool:
+        """Whether this candidate's runtime cost depends on board
+        content (the sparse engine's dirty map): such candidates are
+        never pruned by the static ops bound — tracing counts both
+        branches of the gate, so the bound would be meaningless."""
+        return bool(self.plan.get("sparse_tile"))
+
+
+def _feasible(config: GolConfig, mesh_shape: Tuple[int, int],
+              plan: dict) -> bool:
+    try:
+        tuned = apply_plan(config, plan)
+        validate_mesh(tuned.rows, tuned.cols, tuple(mesh_shape),
+                      tuned.rule.radius * tuned.comm_every)
+    except ConfigError:
+        return False
+    return True
+
+
+def _block_candidates(config: GolConfig,
+                      mesh_shape: Tuple[int, int]) -> Iterator[Candidate]:
+    """Pallas block-shape overrides — only where the fused SWAR kernel
+    actually serves the plan (single device, radius 1, supported shape,
+    real TPU lowering): elsewhere the override is dead weight."""
+    if mesh_shape != (1, 1) or config.rule.radius != 1:
+        return
+    from mpi_tpu.backends.tpu import _pallas_single_device_mode
+    from mpi_tpu.ops.pallas_bitlife import _pick_blocks, supports
+
+    use, interpret = _pallas_single_device_mode()
+    if not use or interpret:
+        return
+    gens = config.comm_every
+    if not supports((config.rows, config.cols), config.rule, gens=gens):
+        return
+    H, NW = config.rows, config.cols // 32
+    picked = _pick_blocks(H, NW, gens)
+    if picked is None:
+        return
+    BM, _ = picked
+    seen = {BM}
+    for bm in (BM // 2, BM * 2):
+        if bm and bm not in seen and H % bm == 0:
+            seen.add(bm)
+            yield Candidate({"blocks": [bm, min(bm, 8)]},
+                            f"blocks={bm}x{min(bm, 8)}")
+
+
+def candidates(config: GolConfig, mesh_shape: Tuple[int, int],
+               include_batch: bool = False) -> List[Candidate]:
+    """The ordered candidate list for one requested config: the default
+    plan first (the incumbent), then every feasible single-knob and
+    paired variant.  Knob values already pinned by the request are not
+    re-searched (a user asking for ``comm_every=4`` keeps it)."""
+    out: List[Candidate] = [Candidate()]
+    if config.backend != "tpu":
+        return out
+    if config.comm_every == 1:
+        for k in COMM_EVERY_CANDIDATES:
+            plan = {"comm_every": k}
+            if _feasible(config, mesh_shape, plan):
+                out.append(Candidate(plan, f"comm_every={k}"))
+    if config.sparse_tile == 0 and mesh_shape == (1, 1):
+        for T in SPARSE_TILE_CANDIDATES:
+            plan = {"sparse_tile": T}
+            if _feasible(config, mesh_shape, plan):
+                out.append(Candidate(plan, f"sparse_tile={T}"))
+    out.extend(_block_candidates(config, mesh_shape))
+    if include_batch:
+        for B in (2, 4, 8):
+            out.append(Candidate({"batch": B}, f"batch={B}"))
+    return out

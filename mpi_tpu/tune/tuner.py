@@ -1,0 +1,418 @@
+"""The probe loop: measure candidates, prune by the cost model, bless
+winners.
+
+Probing is REAL timing — build the candidate engine, run ``steps``
+generations on the actual board, best-of-``reps`` wall clock — because
+the objective the roofline gives us is a bound, not a prediction.  The
+cost model's job is pruning: before paying a candidate's XLA compile,
+its traced jaxpr is op-counted (:mod:`mpi_tpu.obs.opcount` — tracing
+costs milliseconds, compiling seconds) and the candidate is skipped
+when even an optimistic throughput bound cannot beat the incumbent.
+
+The bound never discards the incumbent, by construction: the reference
+throughput ``R`` is the *demonstrated* ops/s — the max over measured
+candidates of (measured cells/s × that candidate's ops/cell), floored
+by the platform roof only when one was measured for this box
+(``MPI_TPU_ROOF_OPS_PER_S``) — and a candidate's bound is
+``margin · R / ops_per_cell`` with ``margin ≥ 1``.  The incumbent's own
+bound is therefore ≥ its own measurement, so it always survives
+(``tests/test_tune.py`` pins this).  Sparse candidates are never pruned
+at all: their cost is data-dependent (the traced program carries both
+sides of the activity gate), so the static count is an upper bound on
+the wrong quantity.
+
+Blessing, before a winner is persisted:
+
+* **parity** — bit-identical final board vs the default plan's output,
+  and (small boards) vs the serial numpy oracle;
+* **IR contract** — every ppermute halo slab in the winner's trace has
+  a depth in ``expected_slab_depths(radius, comm_every, packed)``, the
+  same contract the ir-collective check holds the matrix to;
+* the bench-regression envelope (``tools/bench_gate.py``) stays the
+  outer gate: tuned plans land in ``perf/tune_cache.json``, and the
+  envelope judges the numbers the next capture produces.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from mpi_tpu.config import GolConfig, apply_plan
+from mpi_tpu.tune.cache import TuneCache, platform_fingerprint
+from mpi_tpu.tune.space import Candidate, candidates
+
+# how forgiving the prune bound is: a candidate is only skipped when
+# margin x the demonstrated ops-throughput still cannot reach the best
+# measured cells/s at the candidate's ops/cell.  2x absorbs the usual
+# gap between counted lane-ops and achieved throughput across engines.
+PRUNE_MARGIN = 2.0
+
+# serial-oracle budget: run evolve_np when cells * steps stays under
+# this (beyond it, parity is judged against the default plan's output —
+# itself oracle-verified by the test suite at small sizes)
+ORACLE_CELL_STEPS = 1 << 26
+
+
+@dataclass
+class Probe:
+    """One candidate's outcome."""
+
+    label: str
+    plan: dict
+    status: str                  # "measured" | "pruned" | "failed"
+    cells_per_s: float = 0.0
+    wall_s: float = 0.0
+    ops_per_cell: Optional[float] = None
+    bound_cells_per_s: Optional[float] = None
+    parity: Optional[bool] = None
+    detail: str = ""
+
+    def as_dict(self) -> dict:
+        d = {"label": self.label, "plan": self.plan, "status": self.status,
+             "cells_per_s": round(self.cells_per_s, 1),
+             "wall_s": round(self.wall_s, 6)}
+        if self.ops_per_cell is not None:
+            d["ops_per_cell"] = round(self.ops_per_cell, 3)
+        if self.bound_cells_per_s is not None:
+            d["bound_cells_per_s"] = round(self.bound_cells_per_s, 1)
+        if self.parity is not None:
+            d["parity"] = self.parity
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+
+@dataclass
+class TuneResult:
+    """The winner plus the full probe ledger."""
+
+    config: GolConfig
+    mesh_shape: Tuple[int, int]
+    winner: dict = field(default_factory=dict)
+    winner_label: str = "default"
+    default_cells_per_s: float = 0.0
+    tuned_cells_per_s: float = 0.0
+    probes: List[Probe] = field(default_factory=list)
+    pruned: int = 0
+    oracle: str = "none"
+    key: Optional[str] = None
+
+    @property
+    def speedup(self) -> float:
+        if self.default_cells_per_s <= 0:
+            return 1.0
+        return self.tuned_cells_per_s / self.default_cells_per_s
+
+    def as_dict(self) -> dict:
+        return {
+            "rows": self.config.rows, "cols": self.config.cols,
+            "mesh": list(self.mesh_shape),
+            "winner": self.winner, "winner_label": self.winner_label,
+            "default_cells_per_s": round(self.default_cells_per_s, 1),
+            "tuned_cells_per_s": round(self.tuned_cells_per_s, 1),
+            "speedup": round(self.speedup, 3),
+            "probed": sum(1 for p in self.probes
+                          if p.status == "measured"),
+            "pruned": self.pruned,
+            "oracle": self.oracle,
+            "probes": [p.as_dict() for p in self.probes],
+            "key": self.key,
+        }
+
+
+def should_prune(ops_per_cell: float, demonstrated_ops_per_s: float,
+                 best_cells_per_s: float,
+                 margin: float = PRUNE_MARGIN) -> bool:
+    """Skip a candidate whose optimistic bound cannot beat the best
+    measurement.  ``margin`` is clamped to >= 1 so the bound stays an
+    over-estimate: for the incumbent itself, bound >= demonstrated/opc
+    >= its own measured cells/s — it can never be discarded."""
+    if ops_per_cell <= 0 or demonstrated_ops_per_s <= 0:
+        return False
+    # compare as products (bound < best ⇔ margin·demonstrated <
+    # best·opc): the division form can land one ulp under the
+    # incumbent's own measurement and discard it on rounding alone
+    return (max(margin, 1.0) * demonstrated_ops_per_s
+            < best_cells_per_s * ops_per_cell)
+
+
+def candidate_bound(ops_per_cell: Optional[float],
+                    demonstrated_ops_per_s: float,
+                    margin: float = PRUNE_MARGIN) -> Optional[float]:
+    if ops_per_cell is None or ops_per_cell <= 0 \
+            or demonstrated_ops_per_s <= 0:
+        return None
+    return max(margin, 1.0) * demonstrated_ops_per_s / ops_per_cell
+
+
+def _trace_ops_per_cell(engine, grid, depth: int,
+                        cells: int) -> Optional[float]:
+    """Counted ALU lane-ops per cell-update of the candidate's evolve at
+    ``depth`` — tracing only, no compile, no dispatch."""
+    import jax
+
+    from mpi_tpu.obs.opcount import count_ops
+
+    try:
+        closed = jax.make_jaxpr(
+            lambda g: engine._evolve(g, depth))(grid)
+        total = count_ops(closed)
+    except Exception:  # noqa: BLE001 — a cost estimate, never fatal
+        return None
+    denom = float(cells) * max(depth, 1)
+    return total / denom if denom and total else None
+
+
+def _slab_depths_ok(engine, grid, depth: int) -> Tuple[bool, str]:
+    """The winner-side ir-collective bless: every ppermute operand slab
+    in the traced evolve must be one of the depths
+    ``expected_slab_depths(radius, comm_every, packed)`` allows."""
+    import jax
+
+    from mpi_tpu.parallel.halo import expected_slab_depths
+
+    cfg = engine.config
+    allowed = expected_slab_depths(cfg.rule.radius, cfg.comm_every,
+                                   engine.bitpacked)
+
+    def walk(jaxpr, out):
+        for eqn in jaxpr.eqns:
+            for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
+                sub = eqn.params.get(key)
+                if sub is not None:
+                    walk(sub.jaxpr if hasattr(sub, "jaxpr") else sub, out)
+            if "branches" in eqn.params:
+                for br in eqn.params["branches"]:
+                    walk(br.jaxpr if hasattr(br, "jaxpr") else br, out)
+            if eqn.primitive.name == "ppermute":
+                shape = tuple(eqn.invars[0].aval.shape)
+                out.append(shape)
+
+    try:
+        closed = jax.make_jaxpr(lambda g: engine._evolve(g, depth))(grid)
+        slabs: List[tuple] = []
+        walk(closed.jaxpr, slabs)
+    except Exception as e:  # noqa: BLE001 — report, don't crash the tuner
+        return False, f"slab trace failed: {type(e).__name__}: {e}"
+    for shape in slabs:
+        thin = min(shape) if shape else 0
+        if thin not in allowed:
+            return False, (f"halo slab {shape} depth {thin} not in "
+                           f"{sorted(allowed)}")
+    return True, ""
+
+
+def _measure(engine, board: np.ndarray, steps: int, reps: int,
+             batch: int = 0, settle: int = 0) -> Tuple[float, np.ndarray]:
+    """(best wall seconds, fetched final board) for ``steps``
+    generations — warm first (compile outside the timed window), then
+    best-of-``reps`` fresh runs.  ``settle`` > 0 advances that many
+    untimed generations after each re-init so state-carrying engines
+    (the sparse dirty map starts all-dirty on a fresh grid) are timed in
+    their steady regime; the returned board is then generation
+    ``settle + steps``, identically for every candidate.  ``batch`` > 0
+    times the vmapped batched stepper over B copies and reports
+    per-board wall."""
+    import jax
+
+    def run():
+        if batch:
+            grids = engine.init_grids(initials=[board] * batch)
+            if settle:
+                grids = engine.step_batched(grids, settle)
+                jax.block_until_ready(grids)
+            t0 = time.perf_counter()
+            grids = engine.step_batched(grids, steps)
+            jax.block_until_ready(grids)
+            return time.perf_counter() - t0, grids
+        g = engine.init_grid(initial=board)
+        if settle:
+            g = engine.step(g, settle)
+            jax.block_until_ready(engine.raw_grid(g))
+        t0 = time.perf_counter()
+        g = engine.step(g, steps)
+        jax.block_until_ready(engine.raw_grid(g))
+        return time.perf_counter() - t0, g
+
+    _, out = run()                       # warm: compile both depths
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        wall, out = run()
+        best = min(best, wall)
+    if batch:
+        final = engine.fetch_batched(out)[0]
+        best = best / batch              # per-board wall
+    else:
+        final = engine.fetch(out)
+    return best, np.asarray(final)
+
+
+def tune_plan(config: GolConfig, *, board: Optional[np.ndarray] = None,
+              steps: int = 64, reps: int = 2, settle: int = 0,
+              cache: Optional[TuneCache] = None,
+              cands: Optional[List[Candidate]] = None,
+              include_batch: bool = False,
+              margin: float = PRUNE_MARGIN,
+              min_speedup: float = 1.05,
+              verbose: bool = False) -> TuneResult:
+    """Search the plan space for ``config``; persist the blessed winner.
+
+    ``board`` defaults to the config's seeded random grid fetched from
+    the default engine (probing must compare identical initial states).
+    ``settle`` advances untimed generations before each timed window so
+    state-carrying engines are probed in steady state (parity then
+    compares boards at generation ``settle + steps``).  ``cands``
+    overrides the generated space (tests).  The winner is recorded in
+    ``cache`` (when given) even when the default plan wins — an
+    empty-plan entry tells the next run tuning already happened."""
+    from mpi_tpu.backends.tpu import build_engine, device_count
+    from mpi_tpu.parallel.mesh import choose_mesh_shape, make_mesh
+
+    mesh_shape = config.mesh_shape or choose_mesh_shape(device_count())
+    cells = config.cells
+
+    def log(msg):
+        if verbose:
+            import sys
+
+            print(f"tune: {msg}", file=sys.stderr)
+
+    res = TuneResult(config=config, mesh_shape=mesh_shape)
+    space = cands if cands is not None \
+        else candidates(config, mesh_shape, include_batch=include_batch)
+    # -- incumbent: the default plan, measured first -----------------------
+    default_eng = build_engine(config, mesh=make_mesh(mesh_shape))
+    if board is None:
+        board = default_eng.fetch(default_eng.init_grid())
+    wall, default_out = _measure(default_eng, board, steps, reps,
+                                 settle=settle)
+    res.default_cells_per_s = cells * steps / wall if wall > 0 else 0.0
+    opc0 = _trace_ops_per_cell(default_eng, default_eng.init_grid(
+        initial=board), config.comm_every, cells)
+    res.probes.append(Probe("default", {}, "measured",
+                            cells_per_s=res.default_cells_per_s,
+                            wall_s=wall, ops_per_cell=opc0, parity=True))
+    log(f"default: {res.default_cells_per_s:.3g} cells/s "
+        f"(ops/cell {opc0 if opc0 is None else round(opc0, 2)})")
+    # oracle: serial numpy when affordable, else the default plan output
+    oracle_out = default_out
+    res.oracle = "default-plan"
+    if cells * (settle + steps) <= ORACLE_CELL_STEPS:
+        from mpi_tpu.backends.serial_np import evolve_np
+
+        oracle_out = evolve_np(board, settle + steps, config.rule,
+                               config.boundary)
+        res.oracle = "serial-numpy"
+        if not np.array_equal(default_out, oracle_out):
+            raise AssertionError(
+                "default plan does not match the serial oracle — refusing "
+                "to tune on top of a broken baseline")
+    # demonstrated ops-throughput: what the hardware has actually been
+    # seen to sustain, floored by an explicitly measured roof (never the
+    # committed TPU constant — that would over-prune on other boxes)
+    import os
+
+    demonstrated = 0.0
+    if opc0:
+        demonstrated = res.default_cells_per_s * opc0
+    env_roof = os.environ.get("MPI_TPU_ROOF_OPS_PER_S")
+    if env_roof:
+        try:
+            demonstrated = max(demonstrated, float(env_roof))
+        except ValueError:
+            pass
+    best_cells = res.default_cells_per_s
+    best_plan: dict = {}
+    best_label = "default"
+    # -- the sweep ---------------------------------------------------------
+    for cand in space:
+        if cand.is_default:
+            continue
+        batch = int(cand.plan.get("batch", 0) or 0)
+        try:
+            tuned_cfg = apply_plan(config, cand.plan)
+        except Exception as e:  # noqa: BLE001 — infeasible = skipped
+            res.probes.append(Probe(cand.label, dict(cand.plan), "failed",
+                                    detail=f"{type(e).__name__}: {e}"))
+            continue
+        try:
+            eng = default_eng if batch and not cand.plan.get("blocks") \
+                and tuned_cfg == config else build_engine(
+                    tuned_cfg, mesh=make_mesh(mesh_shape),
+                    blocks=cand.plan.get("blocks"))
+            depth = tuned_cfg.comm_every
+            opc = None
+            if not cand.data_dependent and not batch:
+                opc = _trace_ops_per_cell(
+                    eng, eng.init_grid(initial=board), depth, cells)
+                if opc is not None and should_prune(
+                        opc, demonstrated, best_cells, margin):
+                    res.pruned += 1
+                    res.probes.append(Probe(
+                        cand.label, dict(cand.plan), "pruned",
+                        ops_per_cell=opc,
+                        bound_cells_per_s=candidate_bound(
+                            opc, demonstrated, margin)))
+                    log(f"{cand.label}: pruned (ops/cell {opc:.2f})")
+                    continue
+            wall, out = _measure(eng, board, steps, reps, batch=batch,
+                                 settle=settle)
+            tput = cells * steps / wall if wall > 0 else 0.0
+            parity = np.array_equal(out, oracle_out)
+            probe = Probe(cand.label, dict(cand.plan), "measured",
+                          cells_per_s=tput, wall_s=wall, ops_per_cell=opc,
+                          parity=parity)
+            if not parity:
+                probe.status = "failed"
+                probe.detail = "output differs from oracle"
+                res.probes.append(probe)
+                log(f"{cand.label}: PARITY FAILURE — discarded")
+                continue
+            if tuned_cfg.comm_every > 1 and mesh_shape != (1, 1):
+                ok, why = _slab_depths_ok(
+                    eng, eng.init_grid(initial=board), depth)
+                if not ok:
+                    probe.status = "failed"
+                    probe.detail = why
+                    res.probes.append(probe)
+                    log(f"{cand.label}: IR contract failure ({why})")
+                    continue
+            res.probes.append(probe)
+            if opc:
+                demonstrated = max(demonstrated, tput * opc)
+            log(f"{cand.label}: {tput:.3g} cells/s "
+                f"({tput / max(res.default_cells_per_s, 1e-12):.2f}x)")
+            if tput > best_cells:
+                best_cells, best_plan, best_label = \
+                    tput, dict(cand.plan), cand.label
+        except Exception as e:  # noqa: BLE001 — one sick candidate must
+            # not kill the sweep (Mosaic compile errors, OOM at big B)
+            res.probes.append(Probe(cand.label, dict(cand.plan), "failed",
+                                    detail=f"{type(e).__name__}: {e}"))
+            log(f"{cand.label}: failed ({type(e).__name__}: {e})")
+    # -- bless -------------------------------------------------------------
+    if best_plan and best_cells < res.default_cells_per_s * min_speedup:
+        # a winner inside the noise band is not a winner
+        best_plan, best_label, best_cells = \
+            {}, "default", res.default_cells_per_s
+    res.winner, res.winner_label = best_plan, best_label
+    res.tuned_cells_per_s = best_cells
+    if cache is not None:
+        measured = {
+            "default_cells_per_s": round(res.default_cells_per_s, 1),
+            "tuned_cells_per_s": round(res.tuned_cells_per_s, 1),
+            "speedup": round(res.speedup, 3),
+            "steps": steps, "reps": reps, "settle": settle,
+            "probed": sum(1 for p in res.probes if p.status == "measured"),
+            "pruned": res.pruned,
+            "oracle": res.oracle,
+        }
+        res.key = cache.record(config, mesh_shape, best_plan, measured,
+                               platform=platform_fingerprint())
+        cache.save()
+        log(f"winner {best_label} persisted to {cache.path}")
+    return res

@@ -1,0 +1,311 @@
+"""Tier-1 tests for the plan autotuner (ISSUE 11): tune-cache
+round-trip and corrupt-file tolerance, the never-discards-the-incumbent
+pruning invariant, tuned-plan parity vs the serial numpy oracle for a
+``comm_every>1`` winner, the zero-recompile EngineCache contract for
+cached winners, the ``--check`` staleness gate, and the depth>1
+CostCard ``trip_count_suspect`` caveat.
+
+All on CPU devices (conftest pins JAX_PLATFORMS=cpu with 8 virtual
+devices); tuner probes here use tiny boards and restricted candidate
+lists so the cells stay XLA-compile-bound, not sweep-bound.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.backends.tpu import build_engine
+from mpi_tpu.config import (
+    ConfigError, GolConfig, SIGNATURE_FIELDS, apply_plan,
+)
+from mpi_tpu.models.rules import rule_from_name
+from mpi_tpu.obs.cost import CostCard, ops_per_cell_detail
+from mpi_tpu.parallel.mesh import make_mesh
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.tune import (
+    Candidate, TuneCache, platform_fingerprint, should_prune, tune_key,
+    tune_plan,
+)
+
+
+def _cfg(**kw):
+    base = dict(rows=64, cols=64, steps=0, seed=3,
+                rule=rule_from_name("life"), boundary="periodic",
+                backend="tpu", mesh_shape=(1, 1))
+    base.update(kw)
+    return GolConfig(**base)
+
+
+# ------------------------------------------------------- cache (unit)
+
+
+def test_cache_round_trip(tmp_path):
+    """record → save → reload from disk resolves the same plan."""
+    path = str(tmp_path / "tc.json")
+    cfg = _cfg()
+    cache = TuneCache(path)
+    key = cache.record(cfg, (1, 1), {"sparse_tile": 32}, {"speedup": 2.0})
+    cache.save()
+    reloaded = TuneCache(path)
+    assert reloaded.load_error is None
+    assert reloaded.get(key)["plan"] == {"sparse_tile": 32}
+    tuned, plan = reloaded.resolve(cfg, (1, 1))
+    assert plan == {"sparse_tile": 32}
+    assert tuned.sparse_tile == 32
+    # the key is platform-fingerprinted and arity-versioned
+    assert key.startswith(f"sig{len(SIGNATURE_FIELDS)}|"
+                          f"{platform_fingerprint()}|")
+
+
+def test_cache_key_shares_canonical_rules():
+    """'life' and its explicit B3/S23 spelling share one winner."""
+    a = tune_key(_cfg(rule=rule_from_name("life")), (1, 1), "p")
+    b = tune_key(_cfg(rule=rule_from_name("B3/S23")), (1, 1), "p")
+    assert a == b
+    # ... but a different platform or mesh never does
+    assert tune_key(_cfg(), (1, 1), "p") != tune_key(_cfg(), (1, 1), "q")
+    assert tune_key(_cfg(mesh_shape=None), (1, 1), "p") \
+        != tune_key(_cfg(mesh_shape=None), (1, 2), "p")
+
+
+def test_cache_corrupt_file_reads_as_empty(tmp_path):
+    """A corrupt cache file is an empty cache plus a --check finding —
+    never an exception on the serving path."""
+    path = str(tmp_path / "tc.json")
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    cache = TuneCache(path)
+    assert cache.load_error is not None
+    assert len(cache) == 0
+    cfg = _cfg()
+    tuned, plan = cache.resolve(cfg, (1, 1))
+    assert plan is None and tuned == cfg
+    findings = cache.check()
+    assert any("unreadable" in f for f in findings)
+    # a save repairs the file in place
+    cache.record(cfg, (1, 1), {}, {})
+    cache.save()
+    assert TuneCache(path).load_error is None
+
+
+def test_cache_missing_file_is_clean(tmp_path):
+    cache = TuneCache(str(tmp_path / "absent.json"))
+    assert len(cache) == 0 and cache.load_error is None
+    assert cache.check() == []
+
+
+def test_check_flags_stale_plan(tmp_path):
+    """An entry whose plan no longer validates under current config
+    rules is reported by --check and skipped at resolve time."""
+    path = str(tmp_path / "tc.json")
+    cache = TuneCache(path)
+    cfg = _cfg()
+    # 48 does not divide 64: invalid under today's sparse rules (and a
+    # stand-in for any future rule change that strands an old winner)
+    cache.record(cfg, (1, 1), {"sparse_tile": 48}, {})
+    cache.save()
+    reloaded = TuneCache(path)
+    findings = reloaded.check()
+    assert any("no longer validates" in f for f in findings)
+    tuned, plan = reloaded.resolve(cfg, (1, 1))
+    assert plan is None and tuned == cfg
+
+
+def test_check_flags_orphaned_key(tmp_path):
+    """A key written under a different signature arity (the
+    SIGNATURE_FIELDS extension procedure, MIGRATION.md) stops resolving
+    and --check says so."""
+    path = str(tmp_path / "tc.json")
+    cache = TuneCache(path)
+    cfg = _cfg()
+    key = cache.record(cfg, (1, 1), {}, {})
+    cache.save()
+    with open(path) as fh:
+        raw = json.load(fh)
+    old_key = key.replace(f"sig{len(SIGNATURE_FIELDS)}|", "sig3|", 1)
+    raw["entries"] = {old_key: raw["entries"][key]}
+    with open(path, "w") as fh:
+        json.dump(raw, fh)
+    reloaded = TuneCache(path)
+    assert any("no longer resolves" in f for f in reloaded.check())
+    _, plan = reloaded.resolve(cfg, (1, 1))
+    assert plan is None            # orphaned, not mis-applied
+
+
+def test_apply_plan_rejects_unknown_keys():
+    with pytest.raises(ConfigError):
+        apply_plan(_cfg(), {"rows": 128})
+    assert apply_plan(_cfg(), {}) == _cfg()
+    # plan-only keys pass through without touching the config
+    assert apply_plan(_cfg(), {"blocks": [8, 8]}) == _cfg()
+
+
+# ------------------------------------------------------- pruning
+
+
+def test_pruning_never_discards_the_incumbent():
+    """For ANY measured incumbent, demonstrated >= best * ops_per_cell
+    (it demonstrated that itself), so its bound >= its measurement and
+    should_prune is False — by construction, for every margin >= 0."""
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        opc = float(rng.uniform(0.01, 100.0))
+        best = float(rng.uniform(1.0, 1e12))
+        demonstrated = best * opc      # the incumbent's own evidence
+        for margin in (0.0, 0.5, 1.0, 2.0, 10.0):
+            assert not should_prune(opc, demonstrated, best, margin)
+
+
+def test_pruning_skips_hopeless_candidates():
+    # 100x the ops/cell with only 2x margin headroom cannot win
+    assert should_prune(100.0, 1e9, 1e9, margin=2.0)
+    # unknown/degenerate inputs never prune
+    assert not should_prune(0.0, 1e9, 1e9)
+    assert not should_prune(1.0, 0.0, 1e9)
+
+
+# ------------------------------------------------------- tuner (e2e)
+
+
+def test_tune_plan_end_to_end_records_winner(tmp_path):
+    """A restricted sweep on a tiny board: every probe parity-checked,
+    the incumbent measured, the result persisted (even a default win)."""
+    cfg = _cfg()
+    cache = TuneCache(str(tmp_path / "tc.json"))
+    cands = [Candidate({}, "default"),
+             Candidate({"comm_every": 2}, "comm_every=2")]
+    res = tune_plan(cfg, steps=4, reps=1, cache=cache, cands=cands)
+    assert res.oracle == "serial-numpy"
+    assert res.default_cells_per_s > 0
+    measured = [p for p in res.probes if p.status == "measured"]
+    assert measured and all(p.parity for p in measured)
+    assert res.key is not None and cache.get(res.key) is not None
+    # second construction sees the persisted entry
+    assert TuneCache(cache.path).get(res.key)["measured"]["steps"] == 4
+
+
+def test_tuned_comm_every_winner_matches_numpy_oracle(tmp_path):
+    """A comm_every=2 winner applied through build_engine(tune=...)
+    yields a board bit-identical to the serial numpy oracle."""
+    cfg = _cfg(mesh_shape=(1, 2))
+    cache = TuneCache(str(tmp_path / "tc.json"))
+    cache.record(cfg, (1, 2), {"comm_every": 2}, {})
+    eng = build_engine(cfg, mesh=make_mesh((1, 2)), tune=cache)
+    assert eng.tuned_plan == {"comm_every": 2}
+    assert eng.config.comm_every == 2
+    board = np.asarray(
+        build_engine(cfg, mesh=make_mesh((1, 2))).fetch(
+            build_engine(cfg, mesh=make_mesh((1, 2))).init_grid()),
+        dtype=np.uint8)
+    got = eng.fetch(eng.step(eng.init_grid(initial=board), 8))
+    want = evolve_np(board, 8, cfg.rule, cfg.boundary)
+    assert np.array_equal(np.asarray(got), want)
+
+
+def test_engine_cache_zero_recompile_on_cached_winner(tmp_path):
+    """Serving with tune_cache=: the first create applies the winner on
+    its compile miss; a second same-spec create is an EngineCache hit on
+    the SAME tuned engine with zero additional compiles."""
+    cfg = _cfg()
+    cache = TuneCache(str(tmp_path / "tc.json"))
+    cache.record(cfg, (1, 1), {"sparse_tile": 32}, {})
+    cache.save()
+    mgr = SessionManager(batching=False, async_enabled=False,
+                         tune_cache=cache.path)   # path form, reloaded
+    spec = {"rows": 64, "cols": 64, "backend": "tpu", "mesh": [1, 1],
+            "seed": 3}
+    s1 = mgr.create(spec)
+    e1 = mgr.get(s1["id"]).engine
+    assert e1.tuned_plan == {"sparse_tile": 32}
+    assert s1["tuned_plan"] == {"sparse_tile": 32}   # describe surfaces it
+    mgr.step(s1["id"], 4)
+    compiles = e1.compile_count
+    s2 = mgr.create(spec)
+    e2 = mgr.get(s2["id"]).engine
+    assert s2["cache_hit"] is True
+    assert e2 is e1 and e1.compile_count == compiles
+    mgr.step(s2["id"], 4)
+    assert e1.compile_count == compiles   # depth 4 already compiled
+    # tuned output == untuned output, bit for bit
+    grid, _, _ = mgr.snapshot_array(s2["id"])
+    plain = build_engine(cfg, mesh=make_mesh((1, 1)))
+    want = plain.fetch(plain.step(plain.init_grid(seed=3), 4))
+    assert np.array_equal(grid, np.asarray(want))
+
+
+def test_manager_without_tune_cache_is_untouched(tmp_path):
+    mgr = SessionManager(batching=False, async_enabled=False)
+    s = mgr.create({"rows": 64, "cols": 64, "backend": "tpu",
+                    "mesh": [1, 1]})
+    assert mgr.get(s["id"]).engine.tuned_plan is None
+    assert "tuned_plan" not in s
+
+
+def test_tuned_plans_gauge_counts_provenance(tmp_path):
+    """mpi_tpu_tuned_plans splits live engines by tuned vs default."""
+    from mpi_tpu.obs import Obs
+
+    cfg = _cfg()
+    cache = TuneCache(str(tmp_path / "tc.json"))
+    cache.record(cfg, (1, 1), {"sparse_tile": 32}, {})
+    mgr = SessionManager(batching=False, async_enabled=False,
+                         tune_cache=cache, obs=Obs())
+    mgr.create({"rows": 64, "cols": 64, "backend": "tpu", "mesh": [1, 1],
+                "seed": 3})
+    mgr.create({"rows": 64, "cols": 48, "backend": "tpu", "mesh": [1, 1]})
+    text = mgr.obs.render_metrics()
+    assert 'mpi_tpu_tuned_plans{plan="tuned"} 1' in text
+    assert 'mpi_tpu_tuned_plans{plan="default"} 1' in text
+
+
+def test_runner_check_mode_exit_codes(tmp_path):
+    """python -m mpi_tpu.tune --check: 0 on a clean/missing cache, 1 on
+    findings (the ci_gate stage contract)."""
+    from mpi_tpu.tune.__main__ import main
+
+    clean = str(tmp_path / "absent.json")
+    assert main(["--check", "--cache", clean]) == 0
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        fh.write("nope")
+    assert main(["--check", "--cache", bad]) == 1
+    assert main(["--list", "--cache", clean]) == 0
+
+
+# ------------------------------------------------------- cost caveat
+
+
+def _card(depth, flops=1024.0):
+    return CostCard(sig_label="s", depth=depth, batch=0, flops=flops,
+                    bytes_accessed=0.0, peak_memory_bytes=0.0,
+                    code_size_bytes=0.0, source="xla")
+
+
+def test_trip_count_suspect_flags_depth_gt1_only_cards():
+    """Only depth>1 cards carrying flops → the estimate is kept but
+    flagged: XLA counts a while-loop body once, so it may be low by up
+    to the trip count."""
+    est, suspect = ops_per_cell_detail([_card(8)], cells=4096)
+    assert est == pytest.approx(1024.0 / (4096 * 8)) and suspect
+    # a depth-1 card clears the flag (and is preferred)
+    est, suspect = ops_per_cell_detail([_card(8), _card(1)], cells=4096)
+    assert est == pytest.approx(1024.0 / 4096) and not suspect
+    assert ops_per_cell_detail([], cells=4096) == (None, False)
+    assert ops_per_cell_detail([_card(8, flops=0.0)], 4096) == (None, False)
+
+
+def test_usage_surfaces_trip_count_suspect(tmp_path):
+    """/usage's roofline block carries the caveat (False here: XLA:CPU
+    reports depth-1 flops for the precompiled depth)."""
+    from mpi_tpu.obs import Obs
+
+    mgr = SessionManager(batching=False, async_enabled=False, obs=Obs())
+    s = mgr.create({"rows": 64, "cols": 64, "backend": "tpu",
+                    "mesh": [1, 1], "segments": [1]})
+    mgr.step(s["id"], 1)
+    rows = [r for r in mgr.usage()["signatures"] if "roofline" in r]
+    assert rows and all(
+        r["roofline"]["trip_count_suspect"] is False for r in rows)

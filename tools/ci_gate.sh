@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# One-shot static gate: AST lint -> IR verify -> obs registry smoke,
-# plus an opt-in bench-regression stage.
+# One-shot static gate: AST lint -> IR verify -> obs registry smoke ->
+# tune-cache staleness check, plus an opt-in bench-regression stage.
 #
 # All stages share the exit-code contract (0 clean, 1 findings,
 # 2 internal error); the gate runs every stage even after a failure so
@@ -36,6 +36,11 @@ track $?
 
 note "obs registry smoke (tools/obs_smoke.py --lint-only)"
 python tools/obs_smoke.py --lint-only
+track $?
+
+note "tune cache check (python -m mpi_tpu.tune --check ${TUNE_ARGS:-})"
+# shellcheck disable=SC2086
+python -m mpi_tpu.tune --check ${TUNE_ARGS:-}
 track $?
 
 # Off by default: a wall-clock gate belongs on boxes whose clock means

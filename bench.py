@@ -698,6 +698,8 @@ def _main_inner():
         "value": round(result["value"], 1) if result else 0.0,
         "unit": "cells/s",
         "vs_baseline": round(result["value"] / BASELINE_PER_CHIP, 3) if result else 0.0,
+        "plan": "default",      # bench_gate envelope dimension; tuned-plan
+                                # trajectories (--tune) gate as their own rows
     }
     if (isinstance(mesh_rec, dict)
             and isinstance(mesh_rec.get("per_chip_value"), (int, float))):
@@ -1596,9 +1598,19 @@ def tune_bench() -> None:
         bit_identical = bool(np.array_equal(
             tuned_grid, default_eng.fetch(g)))
 
+        import jax
+
         out.update(
             ok=bool(gate_speedup_ok and zero_recompile and bit_identical),
             rows=N, cols=N, steps=steps,
+            # envelope-compatible keys: the tuned-plan throughput gates
+            # as its own bench_gate row, keyed apart from the default
+            # ladder by the plan dimension
+            metric="cell_updates_per_sec_tuned_plan",
+            value=round(res.tuned_cells_per_s),
+            unit="cells/s",
+            platform=jax.devices()[0].platform,
+            size=N, gens=steps, plan="tuned",
             winner=res.winner, winner_label=res.winner_label,
             default_cells_per_s=round(res.default_cells_per_s),
             tuned_cells_per_s=round(res.tuned_cells_per_s),

@@ -109,6 +109,13 @@ CELLS: List[Cell] = [
          depth=1),
     Cell("sparse_ltl_1x1", 64, 64, rule=_R2, sparse_tile=32, depth=1),
     Cell("batched_sparse_1x1", 64, 64, sparse_tile=32, depth=1, batch=2),
+    # -- 2-host virtual meshes (PR 12): all 8 virtual devices, the
+    # decomposition a 2-host pod slice (2 hosts x 4 chips) would use.
+    # The serve cluster proxies REQUESTS between processes; these cells
+    # pin the collective program a session spanning the slice compiles
+    Cell("packed_2x4_2host", 64, 128, mesh=(2, 4), depth=2, tier="fast"),
+    Cell("packed_1x8_2host", 64, 256, mesh=(1, 8), comm_every=2, depth=3),
+    Cell("ltl_r2_2x4_2host", 64, 128, rule=_R2, mesh=(2, 4), depth=2),
 ]
 
 # (cell_a, cell_b, the one signature-visible field they differ in)
@@ -120,6 +127,9 @@ NEAR_PAIRS: List[Tuple[str, str, str]] = [
     ("packed_1x2_periodic", "highlife_1x2", "rule"),
     ("packed_2x2_dead", "packed_2x2_periodic", "boundary"),
     ("packed_w128_1x2", "packed_w128_overlap_1x2", "overlap"),
+    # the 2-host shapes must be signature-distinct from each other (a
+    # signature blind to the mesh would alias their executables)
+    ("packed_2x4_2host", "ltl_r2_2x4_2host", "rule"),
 ]
 
 _BY_ID = {c.id: c for c in CELLS}

@@ -139,12 +139,26 @@ def required_families(registry: Optional[dict] = None) -> Tuple[List[str],
                                                                 List[str]]:
     """(core, aio) family lists for the runtime smoke: aio families are
     the ones ``serve/aio.py`` registers at construction; everything
-    else must be present on any instrumented scrape."""
+    else must be present on any instrumented scrape.  Families
+    registered by ``mpi_tpu/cluster/`` exist only when serving with
+    ``--peers`` and belong to neither list (see
+    :func:`cluster_families`)."""
     registry = registry or extract_registry()
     core, aio = [], []
     for name, info in sorted(registry["metrics"].items()):
+        if info["module"].startswith("mpi_tpu/cluster/"):
+            continue
         (aio if info["module"] == "mpi_tpu/serve/aio.py" else core).append(name)
     return core, aio
+
+
+def cluster_families(registry: Optional[dict] = None) -> List[str]:
+    """Families registered by ``mpi_tpu/cluster/`` — present on a scrape
+    only in cluster mode (``--peers``), so the runtime smoke checks them
+    separately from the always-on core set."""
+    registry = registry or extract_registry()
+    return sorted(name for name, info in registry["metrics"].items()
+                  if info["module"].startswith("mpi_tpu/cluster/"))
 
 
 # -- README cross-check ---------------------------------------------------

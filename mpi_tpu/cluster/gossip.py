@@ -1,0 +1,85 @@
+"""The gossip wire: push-pull digest exchange over the serving port.
+
+Each node periodically POSTs its digest to every peer's
+``/cluster/gossip`` endpoint (the ordinary serving front — no second
+listener, no second port to firewall).  The receiver applies the digest
+and answers with its *own* digest, which the sender applies in turn —
+push-pull, so one side initiating a round synchronizes both directions
+and a 2-node slice converges in a single interval even if only one
+node's timer has fired yet.
+
+Digests are tiny JSON: peer id, a per-sender sequence number (late or
+duplicate deliveries are discarded by the receiver — idempotent by
+construction), liveness facts, the sender's open-breaker label set, its
+cumulative usage-ledger totals, and its local ``sid -> node`` routes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from mpi_tpu.cluster.proxy import (
+    FORWARDED_HEADER, PeerUnreachable, proxy_request,
+)
+
+GOSSIP_PATH = "/cluster/gossip"
+
+
+def send_digest(addr: str, digest: dict, timeout_s: float = 5.0) -> dict:
+    """POST ``digest`` to one peer; returns the peer's reply (its own
+    digest rides in ``reply["digest"]``).  Raises
+    :class:`~mpi_tpu.cluster.proxy.PeerUnreachable` on transport
+    failure and on a non-JSON or non-200 answer (a peer that cannot
+    speak the protocol is as gone as one that cannot speak at all)."""
+    body = json.dumps(digest).encode()
+    status, _, data = proxy_request(
+        addr, "POST", GOSSIP_PATH, body,
+        # gossip must never be re-routed by the receiving core
+        headers={FORWARDED_HEADER: digest.get("node", "?"),
+                 "Content-Type": "application/json",
+                 "Content-Length": str(len(body))},
+        timeout_s=timeout_s)
+    if status != 200:
+        raise PeerUnreachable(f"peer {addr} answered {status} to gossip")
+    try:
+        reply = json.loads(data)
+    except ValueError as e:
+        raise PeerUnreachable(f"peer {addr} sent non-JSON gossip reply: {e}")
+    if not isinstance(reply, dict):
+        raise PeerUnreachable(f"peer {addr} sent malformed gossip reply")
+    return reply
+
+
+class Gossiper:
+    """The background heartbeat thread: one round of
+    ``node.gossip_now()`` every ``interval_s`` until stopped.  Daemon —
+    a serving process exiting never waits on gossip."""
+
+    def __init__(self, node, interval_s: float):
+        self._node = node
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mpi_tpu-gossip")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._node.gossip_now()
+            except Exception:  # noqa: BLE001 — heartbeats must outlive bugs
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,322 @@
+""":class:`ClusterNode` — one serving process's membership in the slice.
+
+The node owns the cluster-local state the rest of the stack consults:
+
+* identity — the advertised ``host:port`` is the node id; its 6-hex-char
+  sha1 ``tag`` namespaces session ids (``s3-ab12cd``) and ticket ids
+  (``t7@ab12cd``) so any front can read an id and know the owner without
+  a lookup;
+* placement — :meth:`owner_addr` (routing table first, consistent-hash
+  ring fallback) answers "which process serves this session";
+* gossip — :meth:`digest`/:meth:`apply_digest` implement the push-pull
+  exchange (``cluster/gossip.py`` drives it on a timer;
+  :meth:`gossip_now` runs one synchronous round, which the tests and
+  ``tools/cluster_smoke.py`` use for determinism).  A digest carries
+  heartbeat + session count, the sender's open-breaker labels (applied
+  to the local :class:`~mpi_tpu.serve.cache.EngineCache` as
+  remote-open quarantines), cumulative usage-ledger totals, and the
+  sender's local routes;
+* roll-ups — :meth:`usage_rollup` (the ``cluster`` block on
+  ``GET /usage``) sums the latest ledger snapshot from every node
+  exactly; :meth:`health_block` (the ``cluster`` block on ``/healthz``)
+  reports per-peer liveness from heartbeat age.
+
+Everything here is stdlib; nothing imports jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+from mpi_tpu.config import ConfigError
+from mpi_tpu.cluster.gossip import Gossiper, send_digest
+from mpi_tpu.cluster.proxy import PeerUnreachable, split_addr
+from mpi_tpu.cluster.ring import HashRing, RoutingTable
+
+
+def node_tag(addr: str) -> str:
+    """The 6-hex-char tag a node stamps into the ids it allocates —
+    deterministic from the advertised address, so every peer can map an
+    id back to its owner without any protocol round."""
+    return hashlib.sha1(addr.encode()).hexdigest()[:6]
+
+
+class _PeerState:
+    """What gossip has taught us about one peer (guarded by the node
+    lock)."""
+
+    __slots__ = ("addr", "tag", "last_seen", "last_seq", "sessions",
+                 "ledger", "breakers_open")
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.tag = node_tag(addr)
+        self.last_seen: Optional[float] = None      # monotonic heartbeat
+        self.last_seq = 0
+        self.sessions = 0
+        self.ledger: Optional[dict] = None          # latest totals() snapshot
+        self.breakers_open: List[str] = []
+
+
+class ClusterNode:
+    """One process's view of the slice.  Constructed after the serving
+    socket is bound (the advertise address must be real), attached via
+    ``SessionManager.attach_cluster`` and ``AppCore.cluster``."""
+
+    def __init__(self, advertise: str, peers: List[str], manager, *,
+                 interval_s: float = 1.0, timeout_s: float = 5.0,
+                 down_after_s: Optional[float] = None,
+                 state_dir: Optional[str] = None, obs=None):
+        split_addr(advertise)           # validate early: ValueError on junk
+        self.id = advertise
+        self.tag = node_tag(advertise)
+        self.manager = manager
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        # a peer is "down" when its heartbeat is older than this; also
+        # the TTL on remote-open breaker quarantines, so a dead peer's
+        # poisoned-plan warnings age out with its liveness
+        self.down_after_s = (float(down_after_s) if down_after_s is not None
+                             else max(3.0 * self.interval_s, 1.5))
+        self.peers: Dict[str, _PeerState] = {}
+        for addr in peers:
+            addr = addr.strip()
+            if not addr or addr == advertise:
+                continue                # tolerate self in the peer list
+            split_addr(addr)
+            self.peers.setdefault(addr, _PeerState(addr))
+        tags = {self.tag: self.id}
+        for ps in self.peers.values():
+            other = tags.setdefault(ps.tag, ps.addr)
+            if other != ps.addr:
+                raise ConfigError(
+                    f"peer tag collision: {other!r} and {ps.addr!r} both "
+                    f"hash to {ps.tag!r}; change one address")
+        self.ring = HashRing([self.id] + list(self.peers))
+        path = (f"{state_dir}/routing.json" if state_dir else None)
+        self.table = RoutingTable(path)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.gossip_sent = 0
+        self.gossip_received = 0
+        self.gossip_stale = 0           # duplicate/late digests discarded
+        self.gossip_errors = 0
+        self._gossiper = Gossiper(self, interval_s)
+        # session ordinals resume past any restored local sessions so a
+        # restart with the same --state-dir cannot re-issue a live id
+        start = 1
+        for sid in manager.session_ids():
+            m = re.match(r"s(\d+)", sid)
+            if m:
+                start = max(start, int(m.group(1)) + 1)
+        self._sid_counter = itertools.count(start)
+        # restored sessions re-announce themselves to the table (and to
+        # peers, via the routes in every digest)
+        self.table.update({sid: self.id for sid in manager.session_ids()})
+        if obs is not None:
+            self._bind_metrics(obs)
+
+    # -- identity & placement ----------------------------------------------
+
+    def new_session_id(self) -> str:
+        """The next session id this node may allocate — globally unique
+        because the tag is, whichever front the create landed on."""
+        return f"s{next(self._sid_counter)}-{self.tag}"
+
+    def owner_addr(self, sid: str) -> str:
+        """The node serving ``sid``: an explicit route when one is known
+        (create-time record or gossip), else the ring's stateless
+        placement.  Routes naming nodes outside the slice are ignored —
+        a stale table must degrade to the ring, not to a black hole."""
+        route = self.table.get(sid)
+        if route is not None and (route == self.id or route in self.peers):
+            return route
+        return self.ring.owner(sid)
+
+    def ticket_owner_addr(self, tid: str) -> Optional[str]:
+        """The peer owning ticket ``tid``, or None when it is local (our
+        tag, an unsuffixed pre-cluster id, or an unknown tag — the local
+        lookup then answers the structured 404 the contract promises)."""
+        _, sep, tag = tid.partition("@")
+        if not sep or tag == self.tag:
+            return None
+        with self._lock:
+            for ps in self.peers.values():
+                if ps.tag == tag:
+                    return ps.addr
+        return None
+
+    def record_route(self, sid: str) -> None:
+        self.table.update({sid: self.id})
+
+    # -- gossip ------------------------------------------------------------
+
+    def digest(self) -> dict:
+        """This node's current digest.  Breaker labels are the LOCAL
+        open set only — remote-open quarantines learned from gossip are
+        never re-announced, so a label can circulate only while its
+        origin still asserts it (no echo keeping a closed breaker
+        alive)."""
+        mgr = self.manager
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        sids = mgr.session_ids()
+        return {
+            "node": self.id,
+            "seq": seq,
+            "sessions": len(sids),
+            "breakers_open": mgr.cache.breaker_stats()["open"],
+            "ledger": (mgr.obs.ledger.totals()
+                       if mgr.obs is not None else None),
+            "routes": {sid: self.id for sid in sids},
+        }
+
+    def apply_digest(self, digest: dict) -> bool:
+        """Fold one received digest in; returns True when it advanced
+        state.  Any delivery refreshes the sender's heartbeat, but only
+        a sequence number beyond the last seen applies — duplicates and
+        stragglers are idempotent no-ops on every roll-up."""
+        addr = digest.get("node")
+        seq = digest.get("seq")
+        ps = self.peers.get(addr)
+        if ps is None or not isinstance(seq, int):
+            return False                # unknown sender or junk: drop
+        with self._lock:
+            ps.last_seen = time.monotonic()
+            if seq <= ps.last_seq:
+                self.gossip_stale += 1
+                return False
+            ps.last_seq = seq
+            ps.sessions = int(digest.get("sessions") or 0)
+            ledger = digest.get("ledger")
+            ps.ledger = ledger if isinstance(ledger, dict) else None
+            ps.breakers_open = [str(b) for b in
+                                (digest.get("breakers_open") or [])]
+            breakers = list(ps.breakers_open)
+            self.gossip_received += 1
+        self.manager.cache.set_remote_open(addr, breakers,
+                                           ttl_s=self.down_after_s)
+        routes = digest.get("routes")
+        if isinstance(routes, dict):
+            self.table.update({str(s): str(n) for s, n in routes.items()})
+        return True
+
+    def gossip_now(self) -> None:
+        """One synchronous push-pull round with every peer (the timer
+        thread's body; also the deterministic hook the tests drive)."""
+        digest = self.digest()
+        for addr in list(self.peers):
+            try:
+                reply = send_digest(addr, digest, timeout_s=self.timeout_s)
+            except PeerUnreachable:
+                with self._lock:
+                    self.gossip_errors += 1
+                continue
+            with self._lock:
+                self.gossip_sent += 1
+            their = reply.get("digest")
+            if isinstance(their, dict):
+                self.apply_digest(their)
+
+    def start(self) -> None:
+        self._gossiper.start()
+
+    def stop(self) -> None:
+        self._gossiper.stop()
+
+    # -- roll-ups ----------------------------------------------------------
+
+    def usage_rollup(self) -> dict:
+        """The ``cluster`` block on ``GET /usage``: exact sums over the
+        local ledger plus each peer's latest gossiped totals (cumulative
+        snapshots, not deltas — replacement is idempotent, so the sum is
+        exact as of each peer's last digest, at most one interval
+        stale)."""
+        from mpi_tpu.obs.ledger import merge_totals
+
+        mgr = self.manager
+        local = mgr.obs.ledger.totals() if mgr.obs is not None else None
+        by_node: Dict[str, Optional[dict]] = {self.id: local}
+        with self._lock:
+            for addr, ps in self.peers.items():
+                by_node[addr] = ps.ledger
+        reporting = [t for t in by_node.values() if t]
+        return {
+            "node": self.id,
+            "nodes": len(by_node),
+            "nodes_reporting": len(reporting),
+            "totals": merge_totals(reporting),
+            "by_node": by_node,
+        }
+
+    def health_block(self) -> dict:
+        """The ``cluster`` block on ``/healthz``: per-peer liveness from
+        heartbeat age.  A down peer never flips the node's own ``ok`` —
+        this process can still serve everything it owns."""
+        now = time.monotonic()
+        peers = {}
+        with self._lock:
+            for addr, ps in self.peers.items():
+                age = (None if ps.last_seen is None
+                       else now - ps.last_seen)
+                peers[addr] = {
+                    "alive": age is not None and age <= self.down_after_s,
+                    "last_seen_age_s": (None if age is None
+                                        else round(age, 3)),
+                    "sessions": ps.sessions,
+                    "breakers_open": list(ps.breakers_open),
+                }
+        return {"node": self.id, "tag": self.tag, "size": 1 + len(peers),
+                "peers": peers}
+
+    def info(self) -> dict:
+        """``GET /cluster`` — the operator's one-stop membership view."""
+        with self._lock:
+            gossip = {
+                "interval_s": self.interval_s,
+                "sent": self.gossip_sent,
+                "received": self.gossip_received,
+                "stale": self.gossip_stale,
+                "errors": self.gossip_errors,
+            }
+        out = self.health_block()
+        out["ring"] = self.ring.nodes
+        out["routes"] = len(self.table)
+        out["gossip"] = gossip
+        return out
+
+    # -- observability -----------------------------------------------------
+
+    def _bind_metrics(self, obs) -> None:
+        """Cluster metric families (scrape-time callbacks, same
+        no-shadow-counting rule as ``Obs.bind_manager``).  Registered
+        only in cluster mode — single-process scrapes keep their exact
+        pre-cluster family set."""
+        m = obs.metrics
+
+        def _peer_liveness():
+            peers = self.health_block()["peers"]
+            alive = sum(1 for p in peers.values() if p["alive"])
+            return [({"state": "alive"}, alive),
+                    ({"state": "down"}, len(peers) - alive)]
+
+        m.gauge_fn("mpi_tpu_cluster_peers",
+                   "Cluster peers by gossip liveness state",
+                   _peer_liveness)
+
+        def _gossip_counts():
+            with self._lock:
+                return [({"direction": "sent"}, self.gossip_sent),
+                        ({"direction": "received"}, self.gossip_received),
+                        ({"direction": "stale"}, self.gossip_stale),
+                        ({"direction": "error"}, self.gossip_errors)]
+
+        m.counter_fn("mpi_tpu_cluster_gossip_total",
+                     "Gossip digests exchanged, by direction/outcome",
+                     _gossip_counts)

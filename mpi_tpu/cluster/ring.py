@@ -1,0 +1,122 @@
+"""Consistent-hash ring + persisted routing table: who owns a session.
+
+Session placement has two layers, consulted in order:
+
+1. the :class:`RoutingTable` — explicit ``sid -> node`` entries,
+   recorded at create time and merged from peers' gossip digests.  With
+   a ``--state-dir`` the table persists (tmp+fsync+replace, same
+   crash-safety idiom as ``serve/recovery.py``), so a restarted front
+   still knows where surviving sessions live even if its ring view
+   changed;
+2. the :class:`HashRing` — sha1 consistent hashing with virtual nodes,
+   the stateless fallback that lets any front place a *new* session id
+   identically without coordination.
+
+Both are pure data structures (no sockets); ``cluster/node.py`` wires
+them to the gossip protocol.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent hashing over a fixed node set.  ``replicas`` virtual
+    points per node smooth the distribution (with 2 nodes and 64 vnodes
+    the split is within a few percent of even); the node set is pinned
+    at construction — membership is static per process lifetime, which
+    is exactly the ``--peers`` contract."""
+
+    def __init__(self, nodes: List[str], replicas: int = 64):
+        if not nodes:
+            raise ValueError("ring needs at least one node")
+        self.nodes = sorted(set(nodes))
+        self.replicas = int(replicas)
+        points = []
+        for node in self.nodes:
+            for i in range(self.replicas):
+                points.append((_hash(f"{node}#{i}"), node))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [n for _, n in points]
+
+    def owner(self, key: str) -> str:
+        """The node owning ``key`` — the first virtual point clockwise
+        from the key's hash (wrapping)."""
+        i = bisect.bisect_right(self._points, _hash(key))
+        if i == len(self._points):
+            i = 0
+        return self._owners[i]
+
+
+class RoutingTable:
+    """Thread-safe ``sid -> node`` map with optional JSON persistence.
+
+    Entries only ever *add or overwrite* (a session's owner is fixed for
+    its lifetime; a re-learned entry is idempotent), and a missing or
+    corrupt file loads as empty — routing degrades to the ring, never
+    blocks startup."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._routes: Dict[str, str] = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if isinstance(data, dict):
+                    self._routes = {str(k): str(v) for k, v in data.items()}
+            except (OSError, ValueError):
+                pass                    # tolerate a torn file: ring fallback
+
+    def get(self, sid: str) -> Optional[str]:
+        with self._lock:
+            return self._routes.get(sid)
+
+    def record(self, sid: str, node: str) -> None:
+        self.update({sid: node})
+
+    def update(self, routes: Dict[str, str]) -> None:
+        """Merge ``routes`` in (gossip apply / local create) and persist
+        when anything changed."""
+        if not routes:
+            return
+        with self._lock:
+            changed = False
+            for sid, node in routes.items():
+                if self._routes.get(sid) != node:
+                    self._routes[str(sid)] = str(node)
+                    changed = True
+            snapshot = dict(self._routes) if changed and self.path else None
+        if snapshot is not None:
+            self._save(snapshot)
+
+    def _save(self, snapshot: Dict[str, str]) -> None:
+        try:
+            tmp = f"{self.path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(snapshot, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            pass                        # persistence is best-effort
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._routes)

@@ -49,9 +49,12 @@ class Obs:
     stable schema whether or not traffic has touched a site yet)."""
 
     def __init__(self, trace_capacity: int = 4096,
-                 trace_log: Optional[str] = None):
+                 trace_log: Optional[str] = None,
+                 instance: Optional[dict] = None):
         self.tracer = Tracer(capacity=trace_capacity, log_path=trace_log)
-        self.metrics = MetricsRegistry()
+        # ``instance`` (cluster mode's host/process identity) becomes
+        # constant labels on every rendered sample; None renders nothing
+        self.metrics = MetricsRegistry(const_labels=instance)
         # per-session/per-signature usage accounting (obs/ledger.py),
         # fed at the dispatch commit sites; process-local by design
         self.ledger = UsageLedger()

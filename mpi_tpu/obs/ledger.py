@@ -37,6 +37,30 @@ import threading
 KINDS = ("solo", "batched", "unit", "host")
 
 
+def merge_totals(totals_list) -> dict:
+    """Exact sum of N :meth:`UsageLedger.totals` payloads — the cluster
+    roll-up arithmetic (``GET /usage``'s ``cluster.totals``).  Each
+    input is a *cumulative* snapshot, so callers sum the LATEST snapshot
+    per node, never deltas: re-merging after a duplicate or late gossip
+    digest is idempotent by construction.  Integer fields stay exact
+    integers; unknown ``by_kind`` keys are carried through (a newer
+    peer's kinds must not be silently dropped)."""
+    out = {"syncs": 0, "device_s": 0.0, "host_s": 0.0, "generations": 0,
+           "cells": 0, "flops": 0.0, "by_kind": {k: 0 for k in KINDS}}
+    for totals in totals_list:
+        if not totals:
+            continue
+        out["syncs"] += int(totals.get("syncs", 0))
+        out["device_s"] += float(totals.get("device_s", 0.0))
+        out["host_s"] += float(totals.get("host_s", 0.0))
+        out["generations"] += int(totals.get("generations", 0))
+        out["cells"] += int(totals.get("cells", 0))
+        out["flops"] += float(totals.get("flops", 0.0))
+        for kind, count in (totals.get("by_kind") or {}).items():
+            out["by_kind"][kind] = out["by_kind"].get(kind, 0) + int(count)
+    return out
+
+
 def _row():
     return {
         "device_s": 0.0,            # this row's share of engine sync wall

@@ -75,6 +75,10 @@ class _Metric:
         self.name = name
         self.help = help_
         self._lock = lock
+        # registry-wide constant labels (cluster mode's host/process),
+        # prepended to every rendered sample; () renders nothing — the
+        # single-process text format is byte-identical
+        self.const: Tuple[Tuple[str, str], ...] = ()
 
     def _header(self) -> List[str]:
         return [f"# HELP {self.name} {self.help}",
@@ -102,9 +106,9 @@ class Counter(_Metric):
         with self._lock:
             items = sorted(self._vals.items())
         for k, v in items:
-            out.append(f"{self.name}{_labels_str(k)} {_fmt(v)}")
+            out.append(f"{self.name}{_labels_str(self.const + k)} {_fmt(v)}")
         if not items:
-            out.append(f"{self.name} 0")
+            out.append(f"{self.name}{_labels_str(self.const)} 0")
         return out
 
 
@@ -133,11 +137,13 @@ class _FnMetric(_Metric):
             return []
         out = self._header()
         if isinstance(val, (int, float)):
-            out.append(f"{self.name} {_fmt(float(val))}")
+            out.append(f"{self.name}{_labels_str(self.const)} "
+                       f"{_fmt(float(val))}")
         else:
             for labels, v in val:
-                out.append(f"{self.name}{_labels_str(_key(labels))} "
-                           f"{_fmt(float(v))}")
+                out.append(
+                    f"{self.name}{_labels_str(self.const + _key(labels))} "
+                    f"{_fmt(float(v))}")
         return out
 
 
@@ -208,17 +214,18 @@ class Histogram(_Metric):
             items = [(k, (list(st[0]), st[1], st[2]))
                      for k, st in sorted(self._series.items())]
         for k, (counts, total, n) in items:
+            ck = self.const + k
             cum = 0
             for bound, c in zip(self.buckets, counts):
                 cum += c
-                labels = k + (("le", "%g" % bound),)
+                labels = ck + (("le", "%g" % bound),)
                 out.append(f"{self.name}_bucket{_labels_str(labels)} {cum}")
             cum += counts[-1]
             out.append(
-                f"{self.name}_bucket{_labels_str(k + (('le', '+Inf'),))} "
+                f"{self.name}_bucket{_labels_str(ck + (('le', '+Inf'),))} "
                 f"{cum}")
-            out.append(f"{self.name}_sum{_labels_str(k)} {_fmt(total)}")
-            out.append(f"{self.name}_count{_labels_str(k)} {n}")
+            out.append(f"{self.name}_sum{_labels_str(ck)} {_fmt(total)}")
+            out.append(f"{self.name}_count{_labels_str(ck)} {n}")
         return out
 
 
@@ -231,9 +238,21 @@ class MetricsRegistry:
     matches (idempotent binding) and replaces it otherwise.
     """
 
-    def __init__(self):
+    def __init__(self, const_labels: Optional[dict] = None):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
+        self._const: Tuple[Tuple[str, str], ...] = _key(const_labels or {})
+
+    def set_const_labels(self, labels: Optional[dict]) -> None:
+        """(Re)set the constant labels stamped on every rendered sample
+        — cluster mode sets ``host``/``process`` here after the serving
+        socket binds.  Single-process serving never calls this, keeping
+        the text format byte-identical to the non-cluster build."""
+        const = _key(labels or {})
+        with self._lock:
+            self._const = const
+            for m in self._metrics.values():
+                m.const = const
 
     def _register(self, cls, name, help_, *args):
         with self._lock:
@@ -243,6 +262,7 @@ class MetricsRegistry:
                 return existing
         m = cls(name, help_, self._lock, *args)
         with self._lock:
+            m.const = self._const
             self._metrics[name] = m
         return m
 

@@ -83,6 +83,11 @@ class EngineCache:
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self._breakers: dict = {}
+        # remote-open quarantines (cluster gossip): signature LABEL ->
+        # {"peer", "expires"}.  Labels, not signature tuples — a peer
+        # cannot ship a Rule object over the wire, and signature_label
+        # is deterministic across processes for identical plans.
+        self._remote_open: dict = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -193,8 +198,33 @@ class EngineCache:
 
     def breaker_allows(self, signature: tuple) -> bool:
         """May the caller dispatch on this signature's engine?  True when
-        closed or half-open (the trial); False while open."""
-        return self.breaker_state(signature) != "open"
+        closed or half-open (the trial); False while open — locally OR
+        on a gossiping peer (a sibling's poisoned plan is quarantined
+        here before this process burns its own retries).  Remote opens
+        have no half-open trial: only the origin dispatches trials, and
+        its close propagates by the label leaving its next digest."""
+        if self.breaker_state(signature) == "open":
+            return False
+        with self._lock:
+            st = self._remote_open.get(signature_label(signature))
+            return st is None or st["expires"] <= time.monotonic()
+
+    def set_remote_open(self, peer: str, labels, ttl_s: float) -> None:
+        """Replace ``peer``'s remote-open label set (one gossip digest's
+        worth).  Replacement — not accumulation — is what makes the
+        origin's breaker CLOSE propagate: a label absent from the next
+        digest is dropped here.  ``ttl_s`` bounds how long a quarantine
+        outlives its origin's last heartbeat."""
+        now = time.monotonic()
+        expires = now + max(0.0, float(ttl_s))
+        with self._lock:
+            self._remote_open = {
+                lb: st for lb, st in self._remote_open.items()
+                if st["peer"] != peer and st["expires"] > now
+            }
+            for lb in labels:
+                self._remote_open[str(lb)] = {"peer": peer,
+                                              "expires": expires}
 
     def breaker_stats(self) -> dict:
         with self._lock:
@@ -208,6 +238,9 @@ class EngineCache:
                     open_.append(signature_label(sig))
                 elif state == "half_open":
                     half.append(signature_label(sig))
+            now = time.monotonic()
+            remote = sorted(lb for lb, st in self._remote_open.items()
+                            if st["expires"] > now)
             return {
                 "threshold": self.breaker_threshold,
                 "cooldown_s": self.breaker_cooldown_s,
@@ -216,6 +249,10 @@ class EngineCache:
                 "consecutive_failures": failures,
                 "open": sorted(open_),
                 "half_open": sorted(half),
+                # quarantines learned from peers — kept apart from
+                # "open" so gossip digests (which send "open") never
+                # re-announce another node's state
+                "remote_open": remote,
             }
 
     def __len__(self) -> int:

@@ -131,6 +131,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-socket write-buffer bound for /stream "
                    "consumers; a slower consumer gets drop-to-latest "
                    "frames instead of an unbounded queue")
+    p.add_argument("--peers", default=None, metavar="HOST:PORT,...",
+                   help="comma-separated peer serving addresses; setting "
+                   "this (or --peers-file) turns on cluster mode: sticky "
+                   "session routing, gossip, and cluster roll-ups on "
+                   "/usage and /healthz.  Unset: single-process serving, "
+                   "bit-identical to pre-cluster builds")
+    p.add_argument("--peers-file", default=None, metavar="PATH",
+                   help="seed-peer file, one host:port per line "
+                   "('#' comments allowed); merged with --peers")
+    p.add_argument("--advertise", default=None, metavar="HOST:PORT",
+                   help="the address peers reach THIS process at (the "
+                   "node id); defaults to the bound host:port, which is "
+                   "only right when peers share the host")
+    p.add_argument("--gossip-interval-s", type=float, default=1.0,
+                   help="seconds between gossip rounds; peer-down and "
+                   "breaker-quarantine TTL default to 3x this")
+    p.add_argument("--peer-timeout-s", type=float, default=5.0,
+                   help="socket timeout for proxied requests and gossip "
+                   "sends to a peer")
     return p
 
 
@@ -194,6 +213,45 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                              profile_dir=args.profile_dir,
                              max_body=args.http_max_body)
     host, port = server.server_address[:2]
+    node = None
+    cluster_mode = (args.peers is not None or args.peers_file is not None)
+    if cluster_mode:
+        import socket
+
+        from mpi_tpu.cluster import ClusterNode
+
+        peers: List[str] = []
+        if args.peers:
+            peers += [a.strip() for a in args.peers.split(",") if a.strip()]
+        if args.peers_file:
+            try:
+                with open(args.peers_file) as f:
+                    for line in f:
+                        line = line.split("#", 1)[0].strip()
+                        if line:
+                            peers.append(line)
+            except OSError as e:
+                print(f"error: --peers-file: {e}", file=sys.stderr)
+                server.server_close()
+                return 2
+        advertise = args.advertise or f"{host}:{port}"
+        try:
+            node = ClusterNode(advertise, peers, manager,
+                               interval_s=args.gossip_interval_s,
+                               timeout_s=args.peer_timeout_s,
+                               state_dir=args.state_dir, obs=obs)
+        except ValueError as e:        # ConfigError included
+            print(f"error: {e}", file=sys.stderr)
+            server.server_close()
+            return 2
+        manager.attach_cluster(node)
+        server.core.cluster = node
+        if obs is not None:
+            # cluster scrapes are federated: every sample carries the
+            # process identity (single-process mode never sets these)
+            obs.metrics.set_const_labels(
+                {"host": socket.gethostname(), "process": advertise})
+        node.start()
     batch = ("off" if args.no_batch else
              f"window {args.batch_window_ms}ms max {args.batch_max}")
     extras = []
@@ -215,6 +273,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         extras.append(f"profile-dir {args.profile_dir}")
     if args.front != "threaded":
         extras.append(f"front {args.front} ({args.aio_workers} workers)")
+    if node is not None:
+        extras.append(f"cluster {node.id} tag {node.tag} "
+                      f"peers {len(node.peers)}")
     extra = (", " + ", ".join(extras)) if extras else ""
     print(f"[mpi_tpu] serving on http://{host}:{port} "
           f"(cache size {args.cache_size}, batch {batch}{extra})", flush=True)
@@ -223,6 +284,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     except KeyboardInterrupt:
         print("[mpi_tpu] shutting down", flush=True)
     finally:
+        if node is not None:
+            node.stop()
         server.server_close()
         if obs is not None:
             obs.close()                 # flush + fsync the trace log

@@ -41,6 +41,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import re
 import threading
 import time
 from typing import Dict, List, Optional
@@ -196,7 +197,7 @@ class StateStore:
 
 
 def _sid_ordinal(sid: str) -> int:
-    try:
-        return int(sid.lstrip("s"))
-    except ValueError:
-        return 1 << 30
+    # the leading digit run only: cluster-format ids ("s5-ab12cd")
+    # must sort by ordinal like plain ones, not saturate the counter
+    m = re.match(r"s(\d+)", sid)
+    return int(m.group(1)) if m else 1 << 30

@@ -349,6 +349,10 @@ class SessionManager:
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._next = 0
+        # cluster membership (mpi_tpu/cluster): None means single-process
+        # mode — every cluster seam below is a no-op and the behavior is
+        # bit-identical to the pre-cluster stack
+        self.cluster = None
         # step listeners (the aio front's stream hub): called after every
         # committed step/board-write, often with the session lock held —
         # a listener must only flip flags and wake a poller, never block
@@ -382,15 +386,33 @@ class SessionManager:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def create(self, spec: dict, timeout_s: Optional[float] = None) -> dict:
+    def attach_cluster(self, node) -> None:
+        """Join a cluster (``mpi_tpu/cluster``): session ids and ticket
+        ids gain the node's tag so any front can route them, and
+        ``usage()``/``health()`` grow their ``cluster`` roll-up blocks.
+        Called once at serve startup, before traffic."""
+        self.cluster = node
+        if self.dispatcher is not None:
+            self.dispatcher.id_suffix = f"@{node.tag}"
+
+    def session_ids(self) -> list:
+        with self._lock:
+            return list(self._sessions)
+
+    def create(self, spec: dict, timeout_s: Optional[float] = None,
+               sid: Optional[str] = None) -> dict:
         """Create a board.  ``timeout_s`` (explicit only — the default
         budget deliberately does NOT cover create: a cold create
         legitimately spends many seconds in XLA, and an abandoned create
-        worker would still register its session) bounds the build."""
+        worker would still register its session) bounds the build.
+        ``sid`` forces the session id (cluster mode: the front that took
+        the request allocates the id so ring placement and id agree);
+        None keeps the local ``s<n>`` allocation."""
         deadline = _Deadline(_normalize_timeout(timeout_s))
-        return _watchdog_call(lambda: self._create(spec), deadline, "create")
+        return _watchdog_call(lambda: self._create(spec, sid=sid),
+                              deadline, "create")
 
-    def _create(self, spec: dict) -> dict:
+    def _create(self, spec: dict, sid: Optional[str] = None) -> dict:
         config, segments = _parse_spec(spec)
         t0 = time.perf_counter()
         with _span(self.obs, "create", backend=config.backend,
@@ -402,9 +424,13 @@ class SessionManager:
         session.setup_s = time.perf_counter() - t0
         session.spec = dict(spec)
         with self._lock:
-            self._next += 1
-            session.id = f"s{self._next}"
-            self._sessions[session.id] = session
+            if sid is None:
+                self._next += 1
+                sid = f"s{self._next}"
+            elif sid in self._sessions:
+                raise ConfigError(f"session id {sid!r} already exists")
+            session.id = sid
+            self._sessions[sid] = session
         self._persist(session)
         info = self.describe(session)
         info["cache"] = self.cache.stats()
@@ -1238,7 +1264,7 @@ class SessionManager:
                         "trip_count_suspect": suspect,
                     }
             sig_rows.append(row)
-        return {
+        out = {
             "totals": ledger.totals(),
             "sessions": ledger.session_rows(),
             "signatures": sig_rows,
@@ -1246,6 +1272,11 @@ class SessionManager:
             "note": "process-local: restarts and restores reset nothing "
                     "but start metering from zero",
         }
+        if self.cluster is not None:
+            # slice-wide roll-up: local totals + each peer's latest
+            # gossiped snapshot (exact sums, at most one interval stale)
+            out["cluster"] = self.cluster.usage_rollup()
+        return out
 
     def health(self) -> dict:
         """The deep ``/healthz`` payload.  ``ok`` is False — the probe
@@ -1258,7 +1289,7 @@ class SessionManager:
         ok = not (br["open"] and not self.degrade)
         age = (round(time.monotonic() - self._last_dispatch_ok, 3)
                if self._last_dispatch_ok is not None else None)
-        return {
+        out = {
             "ok": ok,
             "sessions": len(sessions),
             "tickets_pending": (self.dispatcher.pending()
@@ -1273,6 +1304,12 @@ class SessionManager:
             "faults_injected": (sum(self.faults.injected.values())
                                 if self.faults is not None else 0),
         }
+        if self.cluster is not None:
+            # peer liveness from gossip heartbeats.  Deliberately not
+            # folded into "ok": a down peer makes ITS sessions 404, but
+            # this process still serves everything it owns
+            out["cluster"] = self.cluster.health_block()
+        return out
 
     def __len__(self) -> int:
         with self._lock:

@@ -129,6 +129,10 @@ class AsyncDispatcher:
         self._done_order: deque = deque()           # resolved-ticket eviction
         self._completed_by_sid: Dict[str, int] = {}
         self._next = 0
+        # appended to every allocated ticket id ("@<node-tag>" in
+        # cluster mode, set by SessionManager.attach_cluster): any
+        # front reads the suffix to route GET /result to the owner
+        self.id_suffix = ""
         self._thread: Optional[threading.Thread] = None
         self.tickets_enqueued = 0
         self.tickets_completed = 0
@@ -152,7 +156,8 @@ class AsyncDispatcher:
                     f"{self.queue_max}); retry later or raise "
                     f"--async-queue-max")
             self._next += 1
-            ticket = Ticket(f"t{self._next}", sid, steps, deadline)
+            ticket = Ticket(f"t{self._next}{self.id_suffix}", sid, steps,
+                            deadline)
             self._tickets[ticket.id] = ticket
             self._inbox.append(ticket)
             self.tickets_enqueued += 1

@@ -45,6 +45,9 @@ import traceback
 from typing import Callable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from mpi_tpu.cluster.proxy import (
+    FORWARDED_HEADER, SESSION_ID_HEADER, PeerUnreachable, proxy_request,
+)
 from mpi_tpu.config import ConfigError
 from mpi_tpu.obs.trace import reset_request_id, set_request_id
 from mpi_tpu.serve import wire
@@ -130,6 +133,10 @@ class AppCore:
         self.max_body = int(max_body)
         self.request_ids = itertools.count(1)
         self.obs = self.manager.obs
+        # cluster membership (mpi_tpu/cluster), attached by serve_main
+        # after the socket binds; None routes every request locally —
+        # the pre-cluster behavior, byte-for-byte
+        self.cluster = None
 
     # -- byte accounting (fronts call count_out for stream pushes too) -----
 
@@ -247,6 +254,13 @@ class AppCore:
             return "usage", None, None
         if parts == ["debug", "profile"]:
             return "profile", None, None
+        if parts and parts[0] == "cluster":
+            # served only in cluster mode (self.cluster set); otherwise
+            # falls through _handle to the usual structured 404
+            if len(parts) == 1:
+                return "cluster", None, None
+            if len(parts) == 2:
+                return "cluster", None, parts[1]
         if len(parts) == 2 and parts[0] == "result":
             return "result", parts[1], None     # parts[1] is the ticket id
         if len(parts) == 2 and parts[0] == "stream":
@@ -314,6 +328,21 @@ class AppCore:
                          f"at most {self.max_body} (--http-max-body)",
                 "max_body": self.max_body,
             }, close=True)
+        cluster = self.cluster
+        forced_sid = None
+        if cluster is not None:
+            if kind == "cluster":
+                return self._cluster_endpoint(req, method, verb, transport)
+            if req.headers.get(FORWARDED_HEADER):
+                # one hop max: a forwarded request is served HERE even
+                # if routing views disagree — a stale table can cost a
+                # 404, never a proxy loop
+                forced_sid = req.headers.get(SESSION_ID_HEADER)
+            else:
+                routed, forced_sid = self._cluster_route(
+                    req, transport, kind, sid, method)
+                if routed is not None:
+                    return routed
         if kind == "metrics" and method == "GET":
             if obs is None:
                 return json_response(404, {
@@ -338,7 +367,10 @@ class AppCore:
         if kind == "sessions" and method == "POST":
             body = self._body(req, transport)
             timeout_s = self._timeout_override(req, body)
-            return json_response(200, mgr.create(body, timeout_s=timeout_s))
+            out = mgr.create(body, timeout_s=timeout_s, sid=forced_sid)
+            if cluster is not None:
+                cluster.record_route(out["id"])
+            return json_response(200, out)
         if kind == "result" and method == "GET" and sid is not None:
             result = mgr.ticket_result(
                 sid, wait=self._query_flag(req, "wait"),
@@ -400,6 +432,89 @@ class AppCore:
                 return json_response(200, mgr.close(
                     sid, timeout_s=self._timeout_override(req, {})))
         return json_response(404, {"error": f"no route {method} {req.path}"})
+
+    # -- cluster routing (mpi_tpu/cluster; self.cluster is None outside
+    # cluster mode and none of this runs) ----------------------------------
+
+    def _cluster_endpoint(self, req: Request, method: str,
+                          verb: Optional[str], transport: str) -> Response:
+        cluster = self.cluster
+        if verb == "gossip" and method == "POST":
+            applied = cluster.apply_digest(self._body(req, transport))
+            # push-pull: the reply carries OUR digest, so one initiated
+            # round synchronizes both directions
+            return json_response(200, {"ok": True, "applied": applied,
+                                       "digest": cluster.digest()})
+        if verb is None and method == "GET":
+            return json_response(200, cluster.info())
+        return json_response(404, {"error": f"no route {method} {req.path}"})
+
+    def _cluster_route(self, req: Request, transport: str, kind: str,
+                       sid: Optional[str], method: str):
+        """(response, forced_sid): a :class:`Response` when the request
+        belongs to a peer (proxied, or its failure mapped), else None
+        with the locally-allocated session id for the create path."""
+        cluster = self.cluster
+        if kind == "sessions" and method == "POST":
+            # the receiving front allocates the id, THEN places it — so
+            # the id's owner and the serving process always agree
+            new_sid = cluster.new_session_id()
+            owner = cluster.owner_addr(new_sid)
+            if owner == cluster.id:
+                return None, new_sid
+            return self._proxy_to(owner, req, transport,
+                                  extra={SESSION_ID_HEADER: new_sid},
+                                  missing=("session", new_sid)), None
+        if kind in ("session", "stream") and sid is not None:
+            owner = cluster.owner_addr(sid)
+            if owner == cluster.id:
+                return None, None
+            if kind == "stream":
+                # an open-ended push stream cannot be relayed hop-by-hop
+                # without a parked thread per frame; redirect the client
+                # to the owner instead
+                return Response(
+                    307, b"", "application/json",
+                    headers=[("Location",
+                              f"http://{owner}{req.path}")]), None
+            return self._proxy_to(owner, req, transport,
+                                  missing=("session", sid)), None
+        if kind == "result" and sid is not None:
+            owner = cluster.ticket_owner_addr(sid)
+            if owner is not None:
+                return self._proxy_to(owner, req, transport,
+                                      missing=("ticket", sid)), None
+        return None, None
+
+    def _proxy_to(self, owner: str, req: Request, transport: str,
+                  extra: Optional[dict] = None,
+                  missing: Optional[Tuple[str, str]] = None) -> Response:
+        """Forward one request to ``owner`` and relay its response
+        verbatim (the peer's structured errors ARE the answer)."""
+        cluster = self.cluster
+        raw = self._raw_body(req, transport)
+        headers = {FORWARDED_HEADER: cluster.id}
+        for name in ("Content-Type", "Accept"):
+            value = req.headers.get(name)
+            if value:
+                headers[name] = value
+        if raw:
+            headers["Content-Length"] = str(len(raw))
+        headers.update(extra or {})
+        try:
+            status, ctype, data = proxy_request(
+                owner, req.method, req.path, raw, headers,
+                timeout_s=cluster.timeout_s)
+        except PeerUnreachable as e:
+            what, ident = missing or ("resource", "?")
+            if what == "ticket":
+                # the 404-after-restart ticket contract extended across
+                # the slice: a dead owner's tickets answer the same
+                # structured 404 a restarted single process would
+                return json_response(404, {"error": f"no ticket {ident!r}",
+                                           "peer": owner})
+            return json_response(503, {"error": str(e), "peer": owner})
+        return Response(status, data, ctype)
 
     # -- wire-format helpers -----------------------------------------------
 

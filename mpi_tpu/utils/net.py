@@ -1,0 +1,46 @@
+"""Small stdlib networking helpers shared by the multi-process test
+harnesses (``tests/test_multihost.py``, ``tests/test_cluster.py``) and
+``tools/cluster_smoke.py``.
+
+The classic free-port idiom — bind port 0, read the assigned port,
+close the socket, hand the number to a subprocess — is a probe-then-use
+race: between the close and the subprocess's own bind, any other
+process (including a sibling test) can claim the port.  There is no
+race-free way to reserve a port for *another* process, so the helpers
+here make the race survivable instead: :func:`free_port` keeps the
+probe (it is still the best available guess), :func:`bind_collision`
+recognizes the loser's error text, and callers retry the whole
+launch-with-fresh-port sequence a bounded number of times.
+"""
+
+from __future__ import annotations
+
+import socket
+
+# How many probe-launch rounds a caller should attempt before giving
+# up: collisions need another process to claim the port inside a
+# millisecond-scale window, so even two losses in a row are rare.
+PORT_RETRIES = 3
+
+_COLLISION_MARKERS = (
+    "address already in use",
+    "errno 98",                 # EADDRINUSE (linux)
+    "errno 48",                 # EADDRINUSE (macOS)
+    "only one usage of each socket address",  # winsock text, for hygiene
+)
+
+
+def free_port(host: str = "localhost") -> int:
+    """A currently-free TCP port on ``host`` (the probe half of the
+    probe-then-use idiom — see the module docstring for why callers
+    must still handle :func:`bind_collision` and retry)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def bind_collision(text: str) -> bool:
+    """Does this stderr/exception text look like the port was claimed
+    between the :func:`free_port` probe and the real bind?"""
+    low = (text or "").lower()
+    return any(marker in low for marker in _COLLISION_MARKERS)

@@ -713,13 +713,16 @@ def test_bench_gate_envelope_skips_unusable_runs(tmp_path):
                                  "gens": 8}}),             # error record
     ]
     env = bg.build_envelope(recs)
-    assert env == {("cpu", 8192, 8): {"lo": 4.0e9, "hi": 5.0e9,
-                                      "runs": [2, 3]}}
+    # records without a plan key (the committed pre-plan history) land
+    # on the "default" row
+    assert env == {("cpu", 8192, 8, "default"): {"lo": 4.0e9, "hi": 5.0e9,
+                                                 "runs": [2, 3]}}
 
 
 def test_bench_gate_flags_degraded_passes_clean():
     bg = _bench_gate()
-    env = {("cpu", 8192, 8): {"lo": 4.0e9, "hi": 5.0e9, "runs": [2, 3]}}
+    env = {("cpu", 8192, 8, "default"): {"lo": 4.0e9, "hi": 5.0e9,
+                                         "runs": [2, 3]}}
     clean = {"value": 4.2e9, "platform": "cpu", "size": 8192, "gens": 8}
     ok, msg = bg.gate(clean, env, tolerance=0.25)
     assert ok, msg
@@ -737,6 +740,37 @@ def test_bench_gate_flags_degraded_passes_clean():
     assert not ok
 
 
+def test_bench_gate_tuned_plan_rows_are_separate(tmp_path):
+    """Tuned-plan trajectories form their own envelope rows (PR 12):
+    a tuned record can neither regress against the default ladder's
+    floor nor raise it, and a degraded tuned run trips only the tuned
+    row's gate."""
+    bg = _bench_gate()
+    recs = [
+        (1, {"rc": 0, "parsed": {"value": 4.0e9, "platform": "cpu",
+                                 "size": 8192, "gens": 8}}),
+        (2, {"rc": 0, "parsed": {"value": 9.0e9, "platform": "cpu",
+                                 "size": 8192, "gens": 8,
+                                 "plan": "tuned"}}),
+    ]
+    env = bg.build_envelope(recs)
+    assert set(env) == {("cpu", 8192, 8, "default"),
+                        ("cpu", 8192, 8, "tuned")}
+    assert env[("cpu", 8192, 8, "tuned")]["lo"] == 9.0e9
+    # a default run well under the tuned floor still passes its own row
+    default = {"value": 3.5e9, "platform": "cpu", "size": 8192, "gens": 8}
+    ok, msg = bg.gate(default, env, tolerance=0.25)
+    assert ok, msg
+    # a collapsed tuned run fails the tuned row even though it beats
+    # the default floor
+    tuned_bad = dict(default, value=5.0e9, plan="tuned")
+    ok, msg = bg.gate(tuned_bad, env, tolerance=0.25)
+    assert not ok and "REGRESSION" in msg
+    # synthetic --plan plumbs through to the key
+    assert bg.config_key({"platform": "cpu", "size": 1, "gens": 1,
+                          "plan": "tuned"})[-1] == "tuned"
+
+
 def test_bench_gate_reads_committed_trajectory():
     """The real BENCH_r*.json files at the repo root must parse into a
     non-empty envelope — the CI stage's --dry-run depends on it."""
@@ -744,6 +778,6 @@ def test_bench_gate_reads_committed_trajectory():
     runs = bg.load_history()
     assert len(runs) >= 5
     env = bg.build_envelope(runs)
-    assert ("cpu", 8192, 8) in env
-    slot = env[("cpu", 8192, 8)]
+    assert ("cpu", 8192, 8, "default") in env
+    slot = env[("cpu", 8192, 8, "default")]
     assert 0 < slot["lo"] <= slot["hi"]

@@ -1,0 +1,709 @@
+"""``mpi_tpu/cluster/`` — one logical engine service across a pod slice
+(ISSUE 12).
+
+Two layers of coverage:
+
+* an IN-PROCESS two-node harness — two real ``SessionManager``s behind
+  two real threaded HTTP servers on ephemeral ports, joined by
+  ``ClusterNode``s with a huge gossip interval and ``gossip_now()``
+  driven by hand, so every routing/gossip assertion is deterministic
+  (no timer races, no XLA compiles: every session is serial-backend);
+* a REAL 2-process group — two ``mpi_tpu serve`` subprocesses joined by
+  ``--peers``, exercising the acceptance flow end to end: sessions
+  served through either front, then one process killed and the
+  survivor's structured-404 ticket contract + peer-down health checked.
+
+The breaker-gossip and rolled-up ``/usage`` acceptance flows also run
+as a 2-process smoke in ``tools/cluster_smoke.py`` (a ci_gate stage).
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.cluster import (
+    ClusterNode, HashRing, RoutingTable, node_tag,
+)
+from mpi_tpu.cluster.proxy import FORWARDED_HEADER, split_addr
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.obs import Obs
+from mpi_tpu.obs.ledger import merge_totals
+from mpi_tpu.serve.cache import EngineCache, signature_label
+from mpi_tpu.serve.httpd import make_server
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+from mpi_tpu.utils.net import PORT_RETRIES, bind_collision, free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a synthetic plan signature shaped like mpi_tpu.config.plan_signature's
+# output: signature_label() renders it identically in every process, so
+# a breaker label gossiped from one node resolves on another
+SYNTH_SIG = (64, 64, "life", "periodic", "tpu", (1, 1))
+
+
+def _oracle(rows, cols, seed, steps, boundary="periodic", rule=LIFE):
+    return evolve_np(init_tile_np(rows, cols, seed), steps, rule, boundary)
+
+
+def _grid_of(snap):
+    return np.array([[int(c) for c in row] for row in snap["grid"]],
+                    dtype=np.uint8)
+
+
+# ------------------------------------------------------- in-process pair
+
+
+class _Node:
+    """One in-process serving node: manager + threaded server +
+    ClusterNode (gossip timer effectively disabled; tests call
+    ``gossip_now`` themselves)."""
+
+    def __init__(self, with_obs=False, state_dir=None, **cache_kw):
+        self.obs = Obs() if with_obs else None
+        self.mgr = SessionManager(EngineCache(max_size=4, **cache_kw),
+                                  batching=False, obs=self.obs,
+                                  state_dir=state_dir)
+        self.srv = make_server("127.0.0.1", 0, self.mgr)
+        host, port = self.srv.server_address[:2]
+        self.addr = f"{host}:{port}"
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.node = None
+
+    def join(self, peers, state_dir=None, down_after_s=None):
+        self.node = ClusterNode(self.addr, peers, self.mgr,
+                                interval_s=3600.0,
+                                down_after_s=down_after_s,
+                                state_dir=state_dir, obs=self.obs)
+        self.mgr.attach_cluster(self.node)
+        self.srv.core.cluster = self.node
+        return self.node
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def _pair(with_obs=False, **kw):
+    a, b = _Node(with_obs=with_obs, **kw), _Node(with_obs=with_obs, **kw)
+    a.join([b.addr])
+    b.join([a.addr])
+    return a, b
+
+
+def _req(addr, method, path, body=None, headers=None):
+    """(status, parsed-or-bytes, header-dict) over one raw connection —
+    the tests need Location and status codes the stdlib openers hide."""
+    conn = http.client.HTTPConnection(addr, timeout=30)
+    payload = json.dumps(body).encode() if body is not None else None
+    conn.request(method, path, body=payload, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    try:
+        return resp.status, json.loads(data), hdrs
+    except (ValueError, UnicodeDecodeError):
+        return resp.status, data, hdrs
+
+
+# ------------------------------------------------------- ring + table
+
+
+def test_hash_ring_is_stable_and_total():
+    nodes = ["h1:8000", "h2:8000", "h3:8000"]
+    ring = HashRing(nodes)
+    keys = [f"s{i}-abcdef" for i in range(300)]
+    owners = {k: ring.owner(k) for k in keys}
+    # deterministic: a second ring over the same nodes agrees on every key
+    ring2 = HashRing(list(reversed(nodes)))
+    assert owners == {k: ring2.owner(k) for k in keys}
+    # total: every owner is a member, and the load actually spreads
+    spread = {n: sum(1 for o in owners.values() if o == n) for n in nodes}
+    assert set(spread) == set(nodes)
+    assert all(count > 0 for count in spread.values()), spread
+    # removing one node only moves that node's keys (consistency)
+    ring3 = HashRing(nodes[:2])
+    moved = [k for k in keys
+             if owners[k] in nodes[:2] and ring3.owner(k) != owners[k]]
+    assert moved == []
+
+
+def test_routing_table_persists_and_tolerates_junk(tmp_path):
+    path = str(tmp_path / "routing.json")
+    t = RoutingTable(path)
+    t.record("s1-aaaaaa", "h1:8000")
+    t.update({"s2-bbbbbb": "h2:8000"})
+    assert len(t) == 2
+    # a fresh table reloads the routes from disk
+    t2 = RoutingTable(path)
+    assert t2.get("s1-aaaaaa") == "h1:8000"
+    assert t2.get("s2-bbbbbb") == "h2:8000"
+    # corrupt file: tolerated (empty table), not fatal
+    with open(path, "w") as f:
+        f.write("{not json")
+    t3 = RoutingTable(path)
+    assert len(t3) == 0
+    # no path: purely in-memory, same API
+    t4 = RoutingTable(None)
+    t4.record("s1-cccccc", "h3:8000")
+    assert t4.get("s1-cccccc") == "h3:8000"
+
+
+def test_node_tag_and_addr_validation():
+    assert node_tag("h1:8000") == node_tag("h1:8000")
+    assert node_tag("h1:8000") != node_tag("h1:8001")
+    assert len(node_tag("h1:8000")) == 6
+    assert split_addr("h1:8000") == ("h1", 8000)
+    with pytest.raises(ValueError):
+        split_addr("not-an-address")
+    mgr = SessionManager(batching=False)
+    with pytest.raises(ValueError):
+        ClusterNode("h1:8000", ["junk"], mgr)
+
+
+# ------------------------------------------------------- bit-identity
+
+
+def test_peers_unset_is_bit_identical_single_process():
+    """The acceptance criterion: without a cluster attached, ids,
+    payload shapes, the /cluster 404, and the metrics text are exactly
+    the pre-cluster single-process forms."""
+    n = _Node(with_obs=True)        # never joins a cluster
+    try:
+        st, out, _ = _req(n.addr, "POST", "/sessions",
+                          {"rows": 16, "cols": 16, "backend": "serial"})
+        assert st == 200 and out["id"] == "s1"
+        st, t, _ = _req(n.addr, "POST", "/sessions/s1/step?async=1",
+                        {"steps": 2})
+        assert st == 200 and t["ticket"] == "t1"      # no @tag suffix
+        st, h, _ = _req(n.addr, "GET", "/healthz")
+        assert st == 200 and "cluster" not in h
+        st, u, _ = _req(n.addr, "GET", "/usage")
+        assert st == 200 and "cluster" not in u
+        # /cluster answers the same structured 404 as any unknown route
+        st, err, _ = _req(n.addr, "GET", "/cluster")
+        assert st == 404 and err == {"error": "no route GET /cluster"}
+        # the scrape carries neither instance labels nor cluster families
+        st, text, _ = _req(n.addr, "GET", "/metrics")
+        text = text.decode() if isinstance(text, bytes) else json.dumps(text)
+        assert "mpi_tpu_cluster_" not in text
+        assert 'host="' not in text and 'process="' not in text
+    finally:
+        n.close()
+
+
+# ------------------------------------------------------- routing + proxy
+
+
+def test_any_front_serves_any_session():
+    """Creates land on the ring owner (proxied when that is the peer);
+    afterwards BOTH fronts serve step/snapshot/density for every
+    session, and the boards match the serial oracle."""
+    a, b = _pair()
+    try:
+        # allocate through alternating fronts until BOTH nodes own at
+        # least one session (the ring split is even in aggregate, but a
+        # handful of keys can legitimately cluster on one side)
+        sids, seeds = [], []
+        i = 0
+        while i < 6 or not (set(a.mgr.session_ids())
+                            and set(b.mgr.session_ids())):
+            front = (a, b)[i % 2]
+            st, out, _ = _req(front.addr, "POST", "/sessions",
+                              {"rows": 24, "cols": 24, "backend": "serial",
+                               "seed": i})
+            assert st == 200, out
+            sids.append(out["id"])
+            seeds.append(i)
+            i += 1
+            assert i < 40, "ring never placed a session on both nodes"
+        assert len(set(sids)) == len(sids)
+        owned_a = set(a.mgr.session_ids())
+        owned_b = set(b.mgr.session_ids())
+        assert owned_a and owned_b and not (owned_a & owned_b)
+        assert owned_a | owned_b == set(sids)
+        for i, sid in zip(seeds, sids):
+            # step through the front that does NOT own it
+            other = b if sid in owned_a else a
+            st, out, _ = _req(other.addr, "POST",
+                              f"/sessions/{sid}/step", {"steps": 4})
+            assert st == 200 and out["generation"] == 4, out
+            # snapshot through both fronts: identical, oracle-exact
+            st1, snap1, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+            st2, snap2, _ = _req(b.addr, "GET", f"/sessions/{sid}/snapshot")
+            assert st1 == st2 == 200
+            assert snap1 == snap2
+            assert np.array_equal(_grid_of(snap1), _oracle(24, 24, i, 4))
+        # routing table knows every placement on both sides after gossip
+        a.node.gossip_now()
+        for sid in sids:
+            assert a.node.owner_addr(sid) == b.node.owner_addr(sid)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cluster_session_ids_carry_allocating_tag():
+    a, b = _pair()
+    try:
+        st, out, _ = _req(a.addr, "POST", "/sessions",
+                          {"rows": 16, "cols": 16, "backend": "serial"})
+        assert st == 200
+        assert out["id"].startswith("s1-")
+        assert out["id"].endswith(a.node.tag)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tickets_route_by_tag_through_either_front():
+    a, b = _pair()
+    try:
+        # place one session on each node (allocate until both own one)
+        sids = []
+        while not sids or len({a.node.owner_addr(s) for s in sids}) < 2:
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": len(sids)})
+            assert st == 200
+            sids.append(out["id"])
+        for sid in sids:
+            owner = a.node.owner_addr(sid)
+            other = b.addr if owner == a.addr else a.addr
+            # submit through the NON-owner front: proxied to the owner,
+            # whose dispatcher stamps ITS tag into the ticket id
+            st, t, _ = _req(other, "POST", f"/sessions/{sid}/step?async=1",
+                            {"steps": 2})
+            assert st == 200, t
+            tag = owner.split(":")[0] and node_tag(owner)
+            assert t["ticket"].endswith(f"@{tag}"), (t, owner)
+            # resolve through BOTH fronts: the non-owner proxies by tag
+            for front in (a.addr, b.addr):
+                st, res, _ = _req(front, "GET",
+                                  f"/result/{t['ticket']}?wait=1")
+                assert st == 200 and res["status"] == "done", res
+        # an unknown ticket with a PEER tag proxies and 404s structurally
+        ghost = f"t999@{b.node.tag}"
+        st, err, _ = _req(a.addr, "GET", f"/result/{ghost}")
+        assert st == 404 and f"no ticket {ghost!r}" in err["error"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_stream_redirects_to_owner():
+    a, b = _pair()
+    try:
+        # find a session owned by b, ask a's front to stream it
+        sid = None
+        seed = 0
+        while sid is None:
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            seed += 1
+            if a.node.owner_addr(out["id"]) == b.addr:
+                sid = out["id"]
+        st, _, hdrs = _req(a.addr, "GET", f"/stream/{sid}")
+        assert st == 307
+        assert hdrs.get("Location") == f"http://{b.addr}/stream/{sid}"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_forwarded_header_is_a_one_hop_loop_guard():
+    a, b = _pair()
+    try:
+        # a session owned by b, requested at a WITH the forwarded marker:
+        # a must answer locally (404 — it does not hold the session),
+        # never proxy again
+        sid = None
+        seed = 0
+        while sid is None:
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            seed += 1
+            if out["id"] not in a.mgr.session_ids():
+                sid = out["id"]
+        st, snap, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+        assert st == 200                        # normal path: proxied
+        st, err, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot",
+                          headers={FORWARDED_HEADER: b.addr})
+        assert st == 404, err                   # forwarded: served here
+    finally:
+        a.close()
+        b.close()
+
+
+def test_routing_table_survives_restart(tmp_path):
+    """A node restarted with the same --state-dir re-learns its routes
+    (and its sid counter resumes past restored sessions)."""
+    state = str(tmp_path / "state-a")
+    a = _Node(state_dir=state)
+    b = _Node()
+    a.join([b.addr], state_dir=state)
+    b.join([a.addr])
+    try:
+        sids = []
+        for i in range(4):
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": i})
+            assert st == 200
+            sids.append(out["id"])
+        routes_before = {s: a.node.owner_addr(s) for s in sids}
+        local_before = sorted(a.mgr.session_ids())
+        a.close()
+        # restart "process a" on a fresh port with the same state dir
+        a2 = _Node(state_dir=state)
+        a2.join([b.addr], state_dir=state)
+        assert sorted(a2.mgr.session_ids()) == local_before
+        # restored routes point at the OLD address for the old node; the
+        # new node ignores routes naming nodes outside the slice, so
+        # placement degrades to the ring, never a black hole
+        for sid in sids:
+            assert a2.node.owner_addr(sid) in (a2.addr, b.addr)
+        # a new create never collides with an existing sid — the
+        # restarted node's fresh tag (new port) keeps ids globally
+        # unique even where ordinals repeat
+        st, out, _ = _req(a2.addr, "POST", "/sessions",
+                          {"rows": 16, "cols": 16, "backend": "serial"})
+        assert st == 200
+        assert out["id"] not in sids
+        assert routes_before  # (silence unused warning in -OO runs)
+        a2.close()
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------- breaker gossip
+
+
+def test_breaker_open_gossips_to_peer_and_close_propagates():
+    a, b = _pair(breaker_threshold=1)
+    try:
+        # trip b's breaker locally on the synthetic signature
+        assert b.mgr.cache.record_failure(SYNTH_SIG)
+        assert not b.mgr.cache.breaker_allows(SYNTH_SIG)
+        label = signature_label(SYNTH_SIG)
+        assert label in b.mgr.cache.breaker_stats()["open"]
+        # one push-pull round from a: the reply digest carries b's open
+        # set, quarantining the label on a WITHOUT a's breaker tripping
+        a.node.gossip_now()
+        assert not a.mgr.cache.breaker_allows(SYNTH_SIG)
+        stats = a.mgr.cache.breaker_stats()
+        assert stats["open"] == []              # not a LOCAL open
+        assert label in stats["remote_open"]
+        # a's own digest must NOT re-announce the remote quarantine
+        assert a.node.digest()["breakers_open"] == []
+        # origin closes -> label leaves its digest -> peer drops it
+        b.mgr.cache.record_success(SYNTH_SIG)
+        a.node.gossip_now()
+        assert a.mgr.cache.breaker_allows(SYNTH_SIG)
+        assert a.mgr.cache.breaker_stats()["remote_open"] == []
+    finally:
+        a.close()
+        b.close()
+
+
+def test_remote_quarantine_expires_with_ttl():
+    cache = EngineCache(max_size=2)
+    cache.set_remote_open("h1:8000", [signature_label(SYNTH_SIG)],
+                          ttl_s=0.05)
+    assert not cache.breaker_allows(SYNTH_SIG)
+    time.sleep(0.08)
+    assert cache.breaker_allows(SYNTH_SIG)
+    assert cache.breaker_stats()["remote_open"] == []
+
+
+# ------------------------------------------------------- ledger roll-up
+
+
+def test_merge_totals_is_exact_integer_arithmetic():
+    t1 = {"syncs": 3, "device_s": 0.25, "host_s": 0.0, "generations": 12,
+          "cells": 12 * 64 * 64, "flops": 1.5e6,
+          "by_kind": {"solo": 2, "unit": 1}}
+    t2 = {"syncs": 5, "device_s": 0.5, "host_s": 0.125, "generations": 20,
+          "cells": 20 * 64 * 64, "flops": 2.5e6,
+          "by_kind": {"solo": 1, "host": 4, "exotic": 7}}
+    out = merge_totals([t1, t2])
+    assert out["syncs"] == 8 and isinstance(out["syncs"], int)
+    assert out["generations"] == 32
+    assert out["cells"] == 32 * 64 * 64 and isinstance(out["cells"], int)
+    assert out["device_s"] == 0.75          # exact: dyadic fractions
+    assert out["host_s"] == 0.125
+    assert out["flops"] == 4.0e6
+    assert out["by_kind"]["solo"] == 3
+    assert out["by_kind"]["host"] == 4
+    assert out["by_kind"]["exotic"] == 7    # unknown kinds carried through
+    assert out["by_kind"]["batched"] == 0
+    # falsy entries (a peer that never reported) are skipped exactly
+    assert merge_totals([t1, None, {}, t1])["syncs"] == 6
+    empty = merge_totals([])
+    assert empty["syncs"] == 0 and set(empty["by_kind"]) == {
+        "solo", "batched", "unit", "host"}
+
+
+def test_rollup_idempotent_under_duplicate_and_late_digests():
+    """Cumulative-snapshot semantics: replaying a digest (same seq) or
+    delivering a stale one (lower seq) changes nothing in the roll-up."""
+    a, b = _pair(with_obs=True)
+    try:
+        totals = {"syncs": 4, "device_s": 0.5, "host_s": 0.0,
+                  "generations": 8, "cells": 1024, "flops": 8.0,
+                  "by_kind": {"solo": 4}}
+        d = {"node": b.addr, "seq": 5, "sessions": 1,
+             "breakers_open": [], "ledger": totals, "routes": {}}
+        assert a.node.apply_digest(dict(d))
+        first = a.node.usage_rollup()
+        assert first["totals"]["syncs"] == totals["syncs"]
+        # a's own (all-zero) ledger still reports; the injected peer
+        # digest is the second reporter
+        assert first["nodes_reporting"] == 2
+        # duplicate (same seq): dropped, counted stale, roll-up unchanged
+        assert not a.node.apply_digest(dict(d))
+        # late (lower seq) with DIFFERENT numbers: also dropped
+        stale = dict(d, seq=3, ledger=dict(totals, syncs=999))
+        assert not a.node.apply_digest(stale)
+        again = a.node.usage_rollup()
+        assert again["totals"] == first["totals"]
+        assert a.node.gossip_stale == 2
+        # a genuinely newer snapshot REPLACES (cumulative, not additive)
+        newer = dict(d, seq=6, ledger=dict(totals, syncs=6))
+        assert a.node.apply_digest(newer)
+        assert a.node.usage_rollup()["totals"]["syncs"] == 6
+    finally:
+        a.close()
+        b.close()
+
+
+def test_live_usage_cluster_totals_equal_sum_of_processes():
+    """The acceptance arithmetic on a live 2-node group: after a gossip
+    round, the ``cluster.totals`` block from EITHER front equals the
+    exact sum of the two per-process ledgers."""
+    a, b = _pair(with_obs=True)
+    try:
+        sids = []
+        for i in range(4):
+            st, out, _ = _req((a, b)[i % 2].addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": i})
+            assert st == 200
+            sids.append(out["id"])
+        for sid in sids:
+            st, out, _ = _req(a.addr, "POST", f"/sessions/{sid}/step",
+                              {"steps": 3})
+            assert st == 200
+        a.node.gossip_now()     # push-pull: one round syncs both ways
+        per_process = [a.obs.ledger.totals(), b.obs.ledger.totals()]
+        assert all(t["syncs"] > 0 for t in per_process)  # both did work
+        want = merge_totals(per_process)
+        for front in (a.addr, b.addr):
+            st, usage, _ = _req(front, "GET", "/usage")
+            assert st == 200
+            block = usage["cluster"]
+            assert block["nodes"] == 2
+            assert block["nodes_reporting"] == 2
+            assert block["totals"] == json.loads(json.dumps(want))
+            assert set(block["by_node"]) == {a.addr, b.addr}
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------------- health + info
+
+
+def test_healthz_reports_peer_down_after_heartbeat_ages():
+    a = _Node()
+    b = _Node()
+    a.join([b.addr], down_after_s=0.2)
+    b.join([a.addr], down_after_s=0.2)
+    try:
+        a.node.gossip_now()
+        st, h, _ = _req(a.addr, "GET", "/healthz")
+        assert st == 200 and h["ok"]
+        assert h["cluster"]["peers"][b.addr]["alive"]
+        b.close()
+        time.sleep(0.3)
+        st, h, _ = _req(a.addr, "GET", "/healthz")
+        # a down peer never flips the node's own ok
+        assert st == 200 and h["ok"]
+        assert not h["cluster"]["peers"][b.addr]["alive"]
+        # a never-seen peer reports not-alive too (fresh node view)
+        c = _Node()
+        c.join([a.addr])
+        st, h, _ = _req(c.addr, "GET", "/healthz")
+        assert not h["cluster"]["peers"][a.addr]["alive"]
+        c.close()
+    finally:
+        a.close()
+
+
+def test_cluster_endpoint_and_metrics():
+    a, b = _pair(with_obs=True)
+    try:
+        a.node.gossip_now()
+        st, info, _ = _req(a.addr, "GET", "/cluster")
+        assert st == 200
+        assert info["size"] == 2 and info["node"] == a.addr
+        assert sorted(info["ring"]) == sorted([a.addr, b.addr])
+        assert info["gossip"]["sent"] >= 1
+        # instance labels are a cluster-mode concern the CLI applies at
+        # bind time; here only the cluster families are bound
+        st, text, _ = _req(a.addr, "GET", "/metrics")
+        text = text.decode() if isinstance(text, bytes) else json.dumps(text)
+        assert 'mpi_tpu_cluster_peers{state="alive"} 1' in text
+        assert 'mpi_tpu_cluster_gossip_total{direction="sent"}' in text
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------------- real processes
+
+
+def _spawn_serve(port, peer_port, tmp, tag):
+    env = dict(os.environ)
+    env["MPI_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return subprocess.Popen(
+        [sys.executable, "-m", "mpi_tpu.cli", "serve",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--peers", f"127.0.0.1:{peer_port}",
+         "--gossip-interval-s", "0.2",
+         "--no-batch"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _wait_healthy(addr, deadline_s=60.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            st, _, _ = _req(addr, "GET", "/healthz")
+            if st == 200:
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise AssertionError(f"server {addr} never became healthy")
+
+
+def test_two_process_group_serves_and_survives_a_kill(tmp_path):
+    """The acceptance flow against REAL processes: serial sessions
+    created through both fronts, transparently proxied verbs, then one
+    process killed — its tickets answer structured 404s at the survivor
+    and the survivor's /healthz reports the peer down."""
+    procs = []
+    try:
+        for attempt in range(PORT_RETRIES):
+            p1, p2 = free_port(), free_port()
+            procs = [_spawn_serve(p1, p2, tmp_path, "n1"),
+                     _spawn_serve(p2, p1, tmp_path, "n2")]
+            time.sleep(0.5)
+            died = [p for p in procs if p.poll() is not None]
+            if died and attempt + 1 < PORT_RETRIES:
+                errs = "".join(p.communicate()[1] for p in died)
+                for p in procs:
+                    p.kill()
+                    p.communicate()
+                if bind_collision(errs):
+                    continue
+                raise AssertionError(f"serve process died:\n{errs[-2000:]}")
+            break
+        a, b = f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"
+        _wait_healthy(a)
+        _wait_healthy(b)
+        # create through both fronts; step + snapshot through the OTHER
+        sids = []
+        for i, front in enumerate((a, b, a, b)):
+            st, out, _ = _req(front, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": i})
+            assert st == 200, out
+            sids.append(out["id"])
+        for i, sid in enumerate(sids):
+            other = b if i % 2 == 0 else a
+            st, out, _ = _req(other, "POST", f"/sessions/{sid}/step",
+                              {"steps": 2})
+            assert st == 200 and out["generation"] == 2, out
+            st, snap, _ = _req(other, "GET", f"/sessions/{sid}/snapshot")
+            assert st == 200
+            assert np.array_equal(_grid_of(snap), _oracle(16, 16, i, 2))
+        # a ticket owned by process 2 (submit at ITS front so the owner
+        # is unambiguous regardless of ring placement): find a sid that
+        # process 2 owns — the one whose direct /sessions read at b is
+        # local is not observable here, so just use any sid and read the
+        # ticket tag instead
+        t2 = None
+        for sid in sids:
+            st, t, _ = _req(b, "POST", f"/sessions/{sid}/step?async=1",
+                            {"steps": 1})
+            assert st == 200, t
+            st, res, _ = _req(a, "GET", f"/result/{t['ticket']}?wait=1")
+            assert st == 200 and res["status"] == "done", res
+            if t["ticket"].endswith(f"@{node_tag(b)}"):
+                t2 = t["ticket"]
+        assert t2 is not None, "no ticket landed on process 2"
+        # kill process 2; the survivor answers the contract
+        procs[1].kill()
+        procs[1].communicate()
+        st, err, _ = _req(a, "GET", f"/result/{t2}")
+        assert st == 404, err
+        assert err["error"] == f"no ticket {t2!r}"
+        assert err["peer"] == b
+        # the survivor's /healthz flips the peer to down within the
+        # heartbeat window (down_after = max(3*0.2, 1.5) = 1.5 s)
+        deadline = time.monotonic() + 10
+        alive = True
+        while alive and time.monotonic() < deadline:
+            st, h, _ = _req(a, "GET", "/healthz")
+            assert st == 200 and h["ok"]    # the survivor itself stays ok
+            alive = h["cluster"]["peers"][b]["alive"]
+            if alive:
+                time.sleep(0.2)
+        assert not alive, "survivor never marked the dead peer down"
+        # ...and still serves everything IT owns
+        local = [s for s in sids
+                 if _req(a, "GET", f"/sessions/{s}",
+                         headers={FORWARDED_HEADER: "probe"})[0] == 200]
+        for sid in local:
+            st, out, _ = _req(a, "POST", f"/sessions/{sid}/step",
+                              {"steps": 1})
+            assert st == 200, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+
+def test_cluster_smoke_tool_is_clean():
+    """The ci_gate stage, as a test — the tool's breaker-gossip stage
+    compiles one tpu-backend plan, so this wrapper is slow-listed
+    (tier1_slow_ids.txt) like the other compile-bound group tests."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cluster_smoke.py")],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, \
+        f"cluster_smoke failed:\n{proc.stdout}\n{proc.stderr[-2000:]}"

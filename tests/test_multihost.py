@@ -9,7 +9,6 @@ cross-process timing aggregation.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -20,14 +19,9 @@ from mpi_tpu import golio
 from mpi_tpu.backends.serial_np import evolve_np
 from mpi_tpu.models.rules import LIFE
 from mpi_tpu.utils.hashinit import init_tile_np
+from mpi_tpu.utils.net import PORT_RETRIES, bind_collision, free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
 
 
 def _launch(pid: int, port: int, out_dir: str, argv=None, n_procs: int = 2,
@@ -55,41 +49,41 @@ def _run_group(out_dir: str, argv=None, n_procs: int = 2,
                devices_per_proc=None) -> None:
     """devices_per_proc: per-pid local device counts (default 2 each) —
     unequal counts model uneven hosts."""
-    port = _free_port()
     devs = devices_per_proc or [2] * n_procs
-    procs = [
-        _launch(pid, port, out_dir, argv, n_procs=n_procs,
-                local_devices=devs[pid])
-        for pid in range(n_procs)
-    ]
-    outs = []
-    # collect everything before asserting: an early assert would leak the
-    # other process (blocked on the dead coordinator) into the session
-    for p in procs:
-        try:
-            outs.append(p.communicate(timeout=300))
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"multihost process failed:\n{out}\n{err[-2000:]}"
+    # the free-port probe is inherently probe-then-use racy (another
+    # process can claim the port before the coordinator binds it), so a
+    # loss that LOOKS like a bind collision retries the whole launch
+    # with a fresh port instead of failing the test
+    for attempt in range(PORT_RETRIES):
+        port = free_port()
+        procs = [
+            _launch(pid, port, out_dir, argv, n_procs=n_procs,
+                    local_devices=devs[pid])
+            for pid in range(n_procs)
+        ]
+        outs = []
+        # collect everything before asserting: an early assert would leak
+        # the other process (blocked on the dead coordinator) into the
+        # session
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=300))
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+        collided = any(p.returncode != 0 and bind_collision(err)
+                       for p, (_, err) in zip(procs, outs))
+        if collided and attempt + 1 < PORT_RETRIES:
+            continue
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, \
+                f"multihost process failed:\n{out}\n{err[-2000:]}"
+        return
 
 
 def test_two_process_multihost_run(tmp_path):
-    port = _free_port()
-    procs = [_launch(pid, port, str(tmp_path)) for pid in (0, 1)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, f"multihost process failed:\n{out}\n{err[-2000:]}"
+    _run_group(str(tmp_path))
 
     # multihost run names are config-derived (identical across hosts)
     name = "run-32x32-16-s5"

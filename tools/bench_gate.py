@@ -7,9 +7,14 @@ is a per-config throughput envelope — this tool turns it into a CI
 stage:
 
 1. load ``BENCH_r*.json`` from the repo root and build the envelope:
-   ``(platform, size, gens) -> [min, max]`` over the usable runs
+   ``(platform, size, gens, plan) -> [min, max]`` over the usable runs
    (``rc == 0``, a parsed record with a positive ``value`` and no
-   ``error``);
+   ``error``).  ``plan`` defaults to ``"default"`` for the pre-plan
+   history; tuned-plan trajectories (``bench.py --tune`` records carry
+   ``plan: "tuned"``) form their own envelope rows so an autotuner
+   regression can never hide inside the default ladder's envelope (and
+   a default regression can never be excused by a tuned high-water
+   mark);
 2. obtain a FRESH number — ``python bench.py`` by default, or a
    synthetic one via ``--from-json``/``--value`` (how the acceptance
    test injects a degraded run without owning slow hardware);
@@ -75,11 +80,11 @@ def _usable(rec: dict):
 
 def config_key(parsed: dict):
     return (str(parsed.get("platform")), parsed.get("size"),
-            parsed.get("gens"))
+            parsed.get("gens"), str(parsed.get("plan") or "default"))
 
 
 def build_envelope(runs):
-    """``(platform, size, gens) -> {"lo", "hi", "runs": [n, ...]}``."""
+    """``(platform, size, gens, plan) -> {"lo", "hi", "runs": [n, ...]}``."""
     env = {}
     for n, rec in runs:
         parsed = _usable(rec)
@@ -160,6 +165,9 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--size", type=int, default=8192)
     ap.add_argument("--gens", type=int, default=8)
+    ap.add_argument("--plan", default="default",
+                    help="envelope plan dimension for a synthetic "
+                         "--value run (e.g. 'tuned')")
     ap.add_argument("--no-write", action="store_true",
                     help="do not append a BENCH_rNN.json for a real run")
     ap.add_argument("--timeout", type=float, default=1800.0,
@@ -187,7 +195,7 @@ def main(argv=None) -> int:
         parsed = {"metric": "cell_updates_per_sec_single_chip",
                   "value": args.value, "unit": "cells/s",
                   "platform": args.platform, "size": args.size,
-                  "gens": args.gens}
+                  "gens": args.gens, "plan": args.plan}
         record = {"cmd": f"--value {args.value}", "rc": 0, "tail": "",
                   "parsed": parsed}
     else:

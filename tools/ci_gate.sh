@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
-# One-shot static gate: AST lint -> IR verify -> obs registry smoke ->
-# tune-cache staleness check, plus an opt-in bench-regression stage.
+# One-shot gate: AST lint -> IR verify -> obs registry smoke ->
+# tune-cache staleness check -> 2-process cluster smoke, plus an opt-in
+# bench-regression stage.
 #
 # All stages share the exit-code contract (0 clean, 1 findings,
 # 2 internal error); the gate runs every stage even after a failure so
@@ -41,6 +42,10 @@ track $?
 note "tune cache check (python -m mpi_tpu.tune --check ${TUNE_ARGS:-})"
 # shellcheck disable=SC2086
 python -m mpi_tpu.tune --check ${TUNE_ARGS:-}
+track $?
+
+note "cluster smoke (tools/cluster_smoke.py)"
+python tools/cluster_smoke.py
 track $?
 
 # Off by default: a wall-clock gate belongs on boxes whose clock means

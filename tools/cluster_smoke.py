@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""2-process cluster smoke: the ISSUE-12 acceptance flow end to end.
+
+Boots a REAL 2-process serve group joined by ``--peers`` and checks, in
+order:
+
+1. **sticky routing / transparent proxy** — serial sessions created
+   through both fronts; every session then steps and snapshots through
+   the front that does NOT own it, and both fronts return identical
+   boards;
+2. **breaker gossip** — both processes run ``--inject-faults
+   'step:1:raise' --breaker-threshold 1``, so the first dispatch of a
+   tpu-backend session opens the owner's breaker; the smoke waits at
+   most a few gossip intervals for the OTHER process to quarantine the
+   same plan label (``/stats`` ``breaker.remote_open``);
+3. **rolled-up /usage** — the ``cluster.totals`` block served by either
+   front converges to the exact sum of the two per-process ledgers
+   (cumulative snapshots: equality, not approximation, once gossip
+   catches up);
+4. **kill one process** — the survivor answers structured 404s
+   (``{"error": "no ticket ...", "peer": ...}``) for the dead peer's
+   tickets and its ``/healthz`` flips the peer to down, while ``ok``
+   stays true and locally-owned sessions keep serving.
+
+Exit-code contract (shared with the other ``tools/ci_gate.sh`` stages):
+0 clean, 1 findings, 2 internal error.  Needs jax only inside the
+serve subprocesses (forced to XLA:CPU), never in this process.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from mpi_tpu.cluster import node_tag                      # noqa: E402
+from mpi_tpu.utils.net import (                           # noqa: E402
+    PORT_RETRIES, bind_collision, free_port,
+)
+
+FAULTS = "step:1:raise"
+GOSSIP_S = 0.25
+
+
+def _req(addr, method, path, body=None):
+    conn = http.client.HTTPConnection(addr, timeout=30)
+    payload = json.dumps(body).encode() if body is not None else None
+    conn.request(method, path, body=payload)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    try:
+        return resp.status, json.loads(data)
+    except (ValueError, UnicodeDecodeError):
+        return resp.status, data
+
+
+def _spawn(port, peer_port):
+    env = dict(os.environ)
+    env["MPI_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT
+    return subprocess.Popen(
+        [sys.executable, "-m", "mpi_tpu.cli", "serve",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--peers", f"127.0.0.1:{peer_port}",
+         "--gossip-interval-s", str(GOSSIP_S),
+         "--inject-faults", FAULTS,
+         "--breaker-threshold", "1",
+         "--no-batch"],
+        env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _wait_healthy(addr, deadline_s=90.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            st, _ = _req(addr, "GET", "/healthz")
+            if st == 200:
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def _poll(deadline_s, fn):
+    """Retry ``fn`` (returning a truthy payload on success) until the
+    deadline; the cluster converges within a gossip interval, so the
+    deadline is slack for slow CI boxes, not the expected latency."""
+    t0 = time.monotonic()
+    while True:
+        out = fn()
+        if out or time.monotonic() - t0 >= deadline_s:
+            return out
+        time.sleep(0.1)
+
+
+def main() -> int:
+    findings = []
+
+    def check(ok, what):
+        print(f"  {'ok' if ok else 'FINDING'}: {what}")
+        if not ok:
+            findings.append(what)
+        return ok
+
+    procs = []
+    try:
+        for attempt in range(PORT_RETRIES):
+            p1, p2 = free_port(), free_port()
+            procs = [_spawn(p1, p2), _spawn(p2, p1)]
+            time.sleep(0.5)
+            died = [p for p in procs if p.poll() is not None]
+            if not died:
+                break
+            errs = "".join(p.communicate()[1] for p in died)
+            for p in procs:
+                p.kill()
+                p.communicate()
+            if bind_collision(errs) and attempt + 1 < PORT_RETRIES:
+                continue
+            print(f"cluster_smoke: serve process died at boot:\n"
+                  f"{errs[-2000:]}", file=sys.stderr)
+            return 2
+        a, b = f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"
+        if not (_wait_healthy(a) and _wait_healthy(b)):
+            print("cluster_smoke: group never became healthy",
+                  file=sys.stderr)
+            return 2
+        print(f"cluster_smoke: group up ({a} tag {node_tag(a)}, "
+              f"{b} tag {node_tag(b)})")
+
+        # -- 1: sticky routing + transparent proxy -----------------------
+        print("stage 1: sticky routing / transparent proxy")
+        sids = []
+        for i in range(4):
+            front = (a, b)[i % 2]
+            st, out = _req(front, "POST", "/sessions",
+                           {"rows": 16, "cols": 16, "backend": "serial",
+                            "seed": i})
+            if not check(st == 200, f"create via {front} -> {st}"):
+                return 1
+            sids.append(out["id"])
+        for i, sid in enumerate(sids):
+            other = (b, a)[i % 2]       # NOT the allocating front
+            st, out = _req(other, "POST", f"/sessions/{sid}/step",
+                           {"steps": 3})
+            check(st == 200 and out.get("generation") == 3,
+                  f"step {sid} via non-allocating front")
+            st1, s1 = _req(a, "GET", f"/sessions/{sid}/snapshot")
+            st2, s2 = _req(b, "GET", f"/sessions/{sid}/snapshot")
+            check(st1 == st2 == 200 and s1 == s2,
+                  f"snapshot {sid} identical through both fronts")
+
+        # -- 2: breaker opens on the owner, gossips to the peer ----------
+        print("stage 2: breaker gossip")
+        st, out = _req(a, "POST", "/sessions",
+                       {"rows": 32, "cols": 32, "backend": "tpu"})
+        if not check(st == 200, f"tpu-backend create -> {st}"):
+            return 1
+        tsid = out["id"]
+        # first dispatch raises (injected), threshold 1 opens the breaker
+        # on whichever process owns the session; the step itself still
+        # succeeds via the serial degrade path
+        st, out = _req(b, "POST", f"/sessions/{tsid}/step", {"steps": 1})
+        check(st == 200, f"faulted step served via degrade -> {st}")
+
+        def _open_label():
+            for addr in (a, b):
+                st, h = _req(addr, "GET", "/stats")
+                if st == 200 and h["breaker"]["open"]:
+                    return addr, h["breaker"]["open"][0]
+            return None
+        owner_open = _poll(5.0, _open_label)
+        if not check(owner_open is not None,
+                     "one process opened its breaker"):
+            return 1
+        owner, label = owner_open
+        peer = b if owner == a else a
+
+        def _quarantined():
+            st, h = _req(peer, "GET", "/stats")
+            return st == 200 and label in h["breaker"].get(
+                "remote_open", [])
+        check(bool(_poll(10 * GOSSIP_S, _quarantined)),
+              f"peer {peer} quarantined {label!r} within a gossip "
+              f"interval of {owner} opening it")
+
+        # -- 3: /usage cluster totals == sum of per-process ledgers ------
+        print("stage 3: rolled-up /usage")
+
+        def _rollup_exact():
+            st1, u1 = _req(a, "GET", "/usage")
+            st2, u2 = _req(b, "GET", "/usage")
+            if st1 != 200 or st2 != 200:
+                return None
+            want_syncs = u1["totals"]["syncs"] + u2["totals"]["syncs"]
+            want_gens = (u1["totals"]["generations"]
+                         + u2["totals"]["generations"])
+            for u in (u1, u2):
+                blk = u.get("cluster")
+                if (blk is None or blk["nodes"] != 2
+                        or blk["totals"]["syncs"] != want_syncs
+                        or blk["totals"]["generations"] != want_gens):
+                    return None
+            return u1["cluster"]["totals"]
+        totals = _poll(10 * GOSSIP_S, _rollup_exact)
+        check(totals is not None,
+              "cluster totals from BOTH fronts equal the exact sum of "
+              "the per-process ledgers")
+        if totals:
+            print(f"  rolled-up totals: syncs={totals['syncs']} "
+                  f"generations={totals['generations']}")
+
+        # -- 4: kill one process -----------------------------------------
+        print("stage 4: kill one process")
+        # a ticket owned by process 2: the dispatcher stamps the OWNER's
+        # tag into the ticket id, so keep allocating sessions until the
+        # ring places one on process 2 (a handful of keys can cluster on
+        # one side; the spread is only even in aggregate)
+        t2 = None
+        extra = 0
+        probe = list(sids)
+        while t2 is None and extra < 32:
+            if not probe:
+                st, out = _req(a, "POST", "/sessions",
+                               {"rows": 16, "cols": 16,
+                                "backend": "serial", "seed": 50 + extra})
+                extra += 1
+                if st != 200:
+                    continue
+                probe.append(out["id"])
+            sid = probe.pop()
+            st, t = _req(b, "POST", f"/sessions/{sid}/step?async=1",
+                         {"steps": 1})
+            if st != 200:
+                continue
+            st, res = _req(a, "GET", f"/result/{t['ticket']}?wait=1")
+            check(st == 200 and res.get("status") == "done",
+                  f"ticket {t['ticket']} resolved via the other front")
+            if t["ticket"].endswith(f"@{node_tag(b)}"):
+                t2 = t["ticket"]
+        if not check(t2 is not None, "a ticket landed on process 2"):
+            return 1
+        procs[1].kill()
+        procs[1].communicate()
+        st, err = _req(a, "GET", f"/result/{t2}")
+        check(st == 404 and err.get("error") == f"no ticket {t2!r}"
+              and err.get("peer") == b,
+              f"dead peer's ticket answers the structured 404 ({err})")
+
+        def _peer_down():
+            st, h = _req(a, "GET", "/healthz")
+            return (st == 200 and h["ok"]
+                    and not h["cluster"]["peers"][b]["alive"])
+        check(bool(_poll(15.0, _peer_down)),
+              "survivor /healthz reports the peer down (ok stays true)")
+        served = 0
+        for sid in sids:
+            st, _ = _req(a, "POST", f"/sessions/{sid}/step", {"steps": 1})
+            served += st == 200
+        check(served > 0, f"survivor still serves its own sessions "
+                          f"({served}/{len(sids)} reachable)")
+
+    except Exception as e:                                # noqa: BLE001
+        print(f"cluster_smoke: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+    if findings:
+        print(f"cluster_smoke: {len(findings)} finding(s)")
+        return 1
+    print("cluster_smoke: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,11 @@ a usage-ledger surface (``GET /usage``, the signature-labelled
 ``mpi_tpu_roofline_efficiency``) that drifts from the describe rows or
 the scrape.
 
+PR 12 adds the cluster-identity contract: a single-process scrape must
+carry NO ``host``/``process`` labels and none of the cluster-only
+families, while an ``Obs`` built with (or re-labelled to) an instance
+identity must stamp both labels on every sample.
+
 This is the contract check for PR 4's tentpole: dashboards and trace
 tooling parse these two text formats, so their shape is API.  Run
 directly (``python tools/obs_smoke.py``) or via the tier-1 wrapper in
@@ -46,6 +51,11 @@ from mpi_tpu.analysis.obsreg import required_families
 # this runtime gate demands it on the next scrape, no hand list to
 # forget.
 REQUIRED_METRICS, AIO_METRICS = required_families()
+# families registered only in cluster mode (mpi_tpu/cluster/, PR 12) —
+# required ABSENT from a single-process scrape, which this smoke drives
+CLUSTER_METRICS = ("mpi_tpu_cluster_peers", "mpi_tpu_cluster_gossip_total")
+# the per-process identity labels cluster mode stamps on every sample
+INSTANCE_LABELS = ("host", "process")
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # ...and the sparse-engine step path (PR 6)
@@ -177,6 +187,36 @@ def check_trace(path, require_async=False, require_sparse=False,
             raise ValueError(f"trace missing wire span kinds: "
                              f"{sorted(missing_kinds)}")
     return len(recs), len(linked)
+
+
+def check_instance_labels():
+    """Cluster-mode renderer contract (PR 12): an ``Obs`` carrying an
+    ``instance=`` identity — or one re-labelled post-bind via
+    ``set_const_labels`` (the ``serve --peers`` path, where the port is
+    unknown until the socket binds) — stamps ``host``/``process`` onto
+    EVERY rendered sample.  Federation dedupes on these labels, so a
+    single unlabelled sample is drift.  Pure renderer check, no
+    server."""
+    from mpi_tpu.obs import Obs
+
+    want = {"host": "smokehost", "process": "127.0.0.1:9"}
+    ctor = Obs(instance=want)                  # constructor path
+    rebound = Obs()
+    rebound.metrics.set_const_labels(want)     # post-bind path (serve cli)
+    for which, iobs in (("instance=", ctor), ("set_const_labels", rebound)):
+        m = iobs.metrics
+        m.get("mpi_tpu_http_requests_total").inc(route="smoke", status="200")
+        m.get("mpi_tpu_dispatch_latency_seconds").observe(0.01)
+        _, samples = parse_prometheus(m.render())
+        if not samples:
+            raise ValueError(f"{which} registry rendered no samples")
+        for name, labels, _ in samples:
+            got = {k: labels.get(k) for k in INSTANCE_LABELS}
+            if got != want:
+                raise ValueError(
+                    f"{which} sample {name} lacks instance labels: "
+                    f"{labels}")
+        iobs.close()
 
 
 def main():
@@ -401,6 +441,17 @@ def main():
         missing = [m for m in AIO_METRICS if m not in types]
         if missing:
             raise ValueError(f"/metrics missing aio families: {missing}")
+        # single-process bit-identity (PR 12): no cluster-only families,
+        # no instance identity labels — the pre-cluster text format
+        present = [m for m in CLUSTER_METRICS if m in types]
+        if present:
+            raise ValueError(f"single-process scrape leaked cluster-mode "
+                             f"families: {present}")
+        for name, labels, _ in samples:
+            leaked = [k for k in INSTANCE_LABELS if k in labels]
+            if leaked:
+                raise ValueError(f"single-process scrape leaked instance "
+                                 f"labels {leaked} on {name}")
         check_histograms(types, samples)
         # the byte counters moved real payloads both ways
         for fam in ("mpi_tpu_http_bytes_in_total",
@@ -514,6 +565,7 @@ def main():
 
     n_recs, n_linked = check_trace(trace_log, require_async=True,
                                    require_sparse=True, require_wire=True)
+    check_instance_labels()
     print(f"obs smoke OK: {len(samples)} metric samples, "
           f"{n_recs} trace records, {n_linked} request lifecycles linked "
           f"({trace_log})")

@@ -47,6 +47,11 @@ _REGISTER_KINDS = {
 # span names assembled at runtime (Obs.phase_sink f-string) and the
 # PhaseTimer phases that feed it
 KNOWN_DYNAMIC_SPANS = {"phase:setup", "phase:steady"}
+# trace-context keys every schema-v2 record may carry (obs/tracectx.py):
+# the README span table must document them as columns and obs_smoke's
+# TRACE_CTX_KEYS literal must match exactly — checked only when the
+# scanned tree actually ships tracectx (fixture corpora predate it)
+TRACE_CONTEXT_COLUMNS = ("trace_id", "span_id", "parent_span_id")
 
 _BACKTICK = re.compile(r"`([^`]+)`")
 _FAMILY_TOKEN = re.compile(r"^mpi_tpu_[a-z0-9_{},*]+$")
@@ -176,6 +181,20 @@ def _expand_token(token: str) -> List[str]:
     return [p[0] for p in parts]
 
 
+def _readme_span_header(lines: Sequence[str]) -> Optional[Tuple[int,
+                                                                List[str]]]:
+    """(line_no, header cells) of the first table whose header's first
+    column is ``span``, or None."""
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if cells and cells[0].strip("`* ").lower() == "span":
+            return i, cells
+    return None
+
+
 def _readme_span_rows(lines: Sequence[str]) -> List[Tuple[int, List[str]]]:
     """(line_no, [span names]) per row of any table whose header's
     first column is ``span``."""
@@ -207,6 +226,9 @@ def check_tree(root: str, files: Sequence[SourceFile],
     registry = extract_registry(root, [sf for sf in files
                                        if sf.rel.startswith("mpi_tpu/")])
     metrics, spans = registry["metrics"], registry["spans"]
+    # the trace-context contract exists only where tracectx shipped —
+    # fixture corpora without it must not be held to it
+    has_tracectx = any(sf.rel == "mpi_tpu/obs/tracectx.py" for sf in files)
     findings: List[Finding] = []
 
     def mk(rel: str, line: int, msg: str) -> Finding:
@@ -240,6 +262,19 @@ def check_tree(root: str, files: Sequence[SourceFile],
             findings.append(mk(readme_rel, 1,
                                "README has no span table (header row "
                                "starting with 'span')"))
+        if has_tracectx and rows:
+            header = _readme_span_header(rlines)
+            if header is not None:
+                hdr_line, hdr_cells = header
+                cols = {c.strip("`* ").lower() for c in hdr_cells}
+                missing_cols = [c for c in TRACE_CONTEXT_COLUMNS
+                                if c not in cols]
+                if missing_cols:
+                    findings.append(mk(
+                        readme_rel, hdr_line,
+                        f"README span table lacks trace-context "
+                        f"column(s) {missing_cols} — schema v2 "
+                        f"(obs/tracectx.py) adds them to every span"))
         # metric-family mentions, both directions
         mentioned: Set[str] = set()
         for i, line in enumerate(rlines, start=1):
@@ -301,6 +336,29 @@ def check_tree(root: str, files: Sequence[SourceFile],
                             smoke_rel, elt.lineno,
                             f"obs_smoke requires span kind '{elt.value}' "
                             f"but no call site under mpi_tpu/ emits it"))
+        if has_tracectx:
+            ctx_keys: Optional[Set[str]] = None
+            ctx_line = 1
+            for node in ast.walk(smoke_tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == "TRACE_CTX_KEYS":
+                    ctx_line = node.lineno
+                    ctx_keys = {elt.value for elt in ast.walk(node.value)
+                                if isinstance(elt, ast.Constant)
+                                and isinstance(elt.value, str)}
+            if ctx_keys is None:
+                findings.append(mk(
+                    smoke_rel, 1,
+                    "obs_smoke lacks a TRACE_CTX_KEYS literal naming the "
+                    "schema-v2 trace-context keys "
+                    f"{list(TRACE_CONTEXT_COLUMNS)}"))
+            elif ctx_keys != set(TRACE_CONTEXT_COLUMNS):
+                findings.append(mk(
+                    smoke_rel, ctx_line,
+                    f"obs_smoke TRACE_CTX_KEYS {sorted(ctx_keys)} drifted "
+                    f"from the schema-v2 context keys "
+                    f"{sorted(TRACE_CONTEXT_COLUMNS)}"))
     return findings
 
 

@@ -359,8 +359,8 @@ class Obs:
 
     # -- export ----------------------------------------------------------
 
-    def render_metrics(self) -> str:
-        return self.metrics.render()
+    def render_metrics(self, openmetrics: bool = False) -> str:
+        return self.metrics.render(openmetrics=openmetrics)
 
     def stats(self) -> dict:
         return {"trace": self.tracer.stats()}

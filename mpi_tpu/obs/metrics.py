@@ -20,13 +20,24 @@ Histogram buckets are FIXED at creation (cumulative ``le`` semantics,
 ``+Inf`` implied): fixed buckets make ``observe`` O(log n_buckets) with
 zero allocation, and bucket counts are monotone by construction — the
 property ``tests/test_obs.py`` asserts on the rendered text.
+
+Histograms additionally keep the last trace context seen per bucket as
+an OpenMetrics *exemplar* (``# {trace_id="..."} value timestamp`` after
+the ``_bucket`` sample).  Exemplars are rendered ONLY when the scraper
+negotiates ``Accept: application/openmetrics-text`` — the default
+Prometheus text stays byte-identical whether or not any were captured,
+and capture itself costs one ``ContextVar`` read (a no-op store when
+the observe happens outside a traced request).
 """
 
 from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from mpi_tpu.obs.tracectx import current_trace_context
 
 # Dispatch/request latencies: 0.5 ms (CPU dispatch floor) up to 10 s
 # (a watchdogged hang) — PERF.md's ~68 ms TPU tunnel constant sits
@@ -66,6 +77,16 @@ def _labels_str(labels: Tuple[Tuple[str, str], ...]) -> str:
 
 def _key(labels: dict) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _exemplar_str(ex: Optional[tuple]) -> str:
+    """OpenMetrics exemplar suffix for a ``_bucket`` sample: the last
+    traced observation that landed in the bucket, or nothing."""
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{_escape(trace_id)}"}} '
+            f"{_fmt(value)} {ts:.3f}")
 
 
 class _Metric:
@@ -161,11 +182,15 @@ class _BoundSeries:
         self._st = st
 
     def observe(self, value: float) -> None:
+        ctx = current_trace_context()
         with self._lock:
             st = self._st
-            st[0][bisect.bisect_left(self._buckets, value)] += 1
+            i = bisect.bisect_left(self._buckets, value)
+            st[0][i] += 1
             st[1] += value
             st[2] += 1
+            if ctx is not None:
+                st[3][i] = (ctx.trace_id, value, time.time())
 
 
 class Histogram(_Metric):
@@ -177,8 +202,13 @@ class Histogram(_Metric):
         if not bs:
             raise ValueError("histogram needs at least one bucket bound")
         self.buckets = bs
-        # label-key -> [per-bucket counts (+1 overflow slot), sum, count]
+        # label-key -> [per-bucket counts (+1 overflow slot), sum, count,
+        #               per-bucket last exemplar (trace_id, value, t) | None]
         self._series: Dict[tuple, list] = {}
+
+    def _new_st(self) -> list:
+        n = len(self.buckets) + 1
+        return [[0] * n, 0.0, 0, [None] * n]
 
     def series(self, **labels) -> _BoundSeries:
         """The pre-bound handle for ``labels`` (created empty if new) —
@@ -187,43 +217,47 @@ class Histogram(_Metric):
         with self._lock:
             st = self._series.get(k)
             if st is None:
-                st = self._series[k] = [[0] * (len(self.buckets) + 1),
-                                        0.0, 0]
+                st = self._series[k] = self._new_st()
         return _BoundSeries(self._lock, self.buckets, st)
 
     def observe(self, value: float, **labels) -> None:
         k = _key(labels)
+        ctx = current_trace_context()
         with self._lock:
             st = self._series.get(k)
             if st is None:
-                st = self._series[k] = [[0] * (len(self.buckets) + 1),
-                                        0.0, 0]
+                st = self._series[k] = self._new_st()
             # le semantics: first bound with value <= bound
-            st[0][bisect.bisect_left(self.buckets, value)] += 1
+            i = bisect.bisect_left(self.buckets, value)
+            st[0][i] += 1
             st[1] += value
             st[2] += 1
+            if ctx is not None:
+                st[3][i] = (ctx.trace_id, value, time.time())
 
     def count(self, **labels) -> int:
         with self._lock:
             st = self._series.get(_key(labels))
             return st[2] if st else 0
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         out = self._header()
         with self._lock:
-            items = [(k, (list(st[0]), st[1], st[2]))
+            items = [(k, (list(st[0]), st[1], st[2], list(st[3])))
                      for k, st in sorted(self._series.items())]
-        for k, (counts, total, n) in items:
+        for k, (counts, total, n, exs) in items:
             ck = self.const + k
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 labels = ck + (("le", "%g" % bound),)
-                out.append(f"{self.name}_bucket{_labels_str(labels)} {cum}")
+                out.append(f"{self.name}_bucket{_labels_str(labels)} {cum}"
+                           f"{_exemplar_str(exs[i]) if exemplars else ''}")
             cum += counts[-1]
             out.append(
                 f"{self.name}_bucket{_labels_str(ck + (('le', '+Inf'),))} "
-                f"{cum}")
+                f"{cum}"
+                f"{_exemplar_str(exs[-1]) if exemplars else ''}")
             out.append(f"{self.name}_sum{_labels_str(ck)} {_fmt(total)}")
             out.append(f"{self.name}_count{_labels_str(ck)} {n}")
         return out
@@ -286,10 +320,19 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition; ``openmetrics=True`` is the
+        negotiated variant that appends histogram exemplars and the
+        ``# EOF`` terminator.  The default render path is untouched by
+        exemplar capture — byte-identical to pre-exemplar builds."""
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         lines: List[str] = []
         for m in metrics:
-            lines.extend(m.render())
+            if openmetrics and isinstance(m, Histogram):
+                lines.extend(m.render(exemplars=True))
+            else:
+                lines.extend(m.render())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"

@@ -22,6 +22,15 @@ request — including watchdog worker threads (via ``copy_context``) and
 batched follower commits (the batcher stashes the id per entry and
 re-enters it around each commit).  One id, end-to-end: that is what
 makes a request's lifecycle greppable out of the JSONL.
+
+Since schema v2 every record may additionally carry the distributed
+trace context (``trace_id``/``span_id``/``parent_span_id``, see
+``obs/tracectx.py``): a ``Span`` entered under an ambient context
+allocates its own span id and re-parents descendants to itself for the
+duration of the block, and events get leaf span ids.  Records emitted
+outside any request (gossip, stream pushes, crash markers) carry no
+trace keys — exactly like ``rid`` — which is also how v1 logs read
+back: the context keys are optional everywhere.
 """
 
 from __future__ import annotations
@@ -34,6 +43,16 @@ import threading
 import time
 from contextvars import ContextVar
 from typing import Any, Dict, List, Optional
+
+from mpi_tpu.obs.tracectx import (
+    TRACE_CONTEXT, TraceContext, reset_trace_context, set_trace_context,
+)
+
+# Ring/record layout and JSONL schema version: v1 records were
+# (seq, name, t0, dur_s, rid, thread, fields); v2 appends the trace
+# context triple (None outside a traced request).  The JSONL keys are
+# strictly additive, so v1 readers and logs interoperate both ways.
+TRACE_SCHEMA_VERSION = 2
 
 # The one process-wide request-id slot.  httpd sets it at request entry;
 # everything downstream (session, batcher, engine, recovery) reads it.
@@ -59,27 +78,43 @@ class Span:
     records name/duration/tags on exit; an exception inside the block is
     recorded as an ``error`` field and re-raised."""
 
-    __slots__ = ("_tracer", "name", "fields", "t0")
+    __slots__ = ("_tracer", "name", "fields", "t0", "_ctx", "_ctx_token")
 
     def __init__(self, tracer: "Tracer", name: str, fields: Dict[str, Any]):
         self._tracer = tracer
         self.name = name
         self.fields = fields
         self.t0 = 0.0
+        self._ctx: Optional[TraceContext] = None
+        self._ctx_token = None
 
     def tag(self, **kv) -> "Span":
         self.fields.update(kv)
         return self
 
+    @property
+    def ctx(self) -> Optional["TraceContext"]:
+        """This span's trace context (None outside a traced request)."""
+        return self._ctx
+
     def __enter__(self) -> "Span":
+        ctx = TRACE_CONTEXT.get()
+        if ctx is not None:
+            # this span becomes the parent of everything in the block
+            self._ctx = ctx.child()
+            self._ctx_token = set_trace_context(self._ctx)
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self.t0
+        if self._ctx_token is not None:
+            reset_trace_context(self._ctx_token)
+            self._ctx_token = None
         if exc_type is not None:
             self.fields["error"] = f"{exc_type.__name__}: {exc}"
-        self._tracer._record(self.name, self.t0, dur, self.fields)
+        self._tracer._record(self.name, self.t0, dur, self.fields,
+                             tctx=self._ctx)
         return False
 
 
@@ -112,13 +147,20 @@ class Tracer:
                      dur_s, fields)
 
     def _record(self, name: str, t0: float, dur_s: float,
-                fields: Dict[str, Any]) -> None:
+                fields: Dict[str, Any],
+                tctx: Optional[TraceContext] = None) -> None:
         rid = fields.pop("rid", None)
         if rid is None:
             rid = REQUEST_ID.get()
+        if tctx is None:
+            # events are leaves: own span id, parented to the ambient
+            # context (one ContextVar.get when untraced — hot-path safe)
+            ctx = TRACE_CONTEXT.get()
+            if ctx is not None:
+                tctx = ctx.child()
         i = next(self._seq)
         rec = (i, name, t0, dur_s, rid,
-               threading.current_thread().name, fields or None)
+               threading.current_thread().name, fields or None, tctx)
         self._buf[i % self.capacity] = rec
         if self.log_path is not None:
             self._stream(rec)
@@ -139,7 +181,7 @@ class Tracer:
     # -- export ----------------------------------------------------------
 
     def _to_dict(self, rec: tuple) -> Dict[str, Any]:
-        i, name, t0, dur_s, rid, thr, fields = rec
+        i, name, t0, dur_s, rid, thr, fields, tctx = rec
         d: Dict[str, Any] = {
             "seq": i,
             "name": name,
@@ -150,6 +192,11 @@ class Tracer:
         }
         if rid is not None:
             d["rid"] = rid
+        if tctx is not None:
+            d["trace_id"] = tctx.trace_id
+            d["span_id"] = tctx.span_id
+            if tctx.parent_span_id is not None:
+                d["parent_span_id"] = tctx.parent_span_id
         if fields:
             for k, v in fields.items():
                 if k not in d:
@@ -195,6 +242,7 @@ class Tracer:
             "recorded": recorded,
             "dropped": max(0, recorded - self.capacity),
             "streaming": self.log_path is not None,
+            "schema": TRACE_SCHEMA_VERSION,
         }
 
     def close(self) -> None:

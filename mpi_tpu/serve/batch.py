@@ -32,6 +32,9 @@ import time
 from mpi_tpu.obs.trace import (
     current_request_id, reset_request_id, set_request_id,
 )
+from mpi_tpu.obs.tracectx import (
+    current_trace_context, reset_trace_context, set_trace_context,
+)
 
 
 class _Entry:
@@ -41,9 +44,11 @@ class _Entry:
     the leader runs follower work on ITS thread, so the contextvar set
     by the HTTP handler does not flow; the leader re-enters each entry's
     id around its commit so downstream spans (checkpoint writes) land
-    under the request that asked for them."""
+    under the request that asked for them.  ``tctx`` carries the
+    submitter's trace context across the same hop for the same reason."""
 
-    __slots__ = ("session", "steps", "event", "result", "error", "rid")
+    __slots__ = ("session", "steps", "event", "result", "error", "rid",
+                 "tctx")
 
     def __init__(self, session, steps: int):
         self.session = session
@@ -52,6 +57,7 @@ class _Entry:
         self.result = None
         self.error = None
         self.rid = current_request_id()
+        self.tctx = current_trace_context()
 
 
 class MicroBatcher:
@@ -225,15 +231,20 @@ class MicroBatcher:
                 e.event.set()
 
     def _step_solo(self, manager, entry, steps: int) -> None:
-        # re-enter the submitter's request id: this runs on the LEADER's
-        # thread, whose contextvar belongs to a different request
+        # re-enter the submitter's request id (and trace context): this
+        # runs on the LEADER's thread, whose contextvars belong to a
+        # different request
         token = set_request_id(entry.rid)
+        ttoken = (set_trace_context(entry.tctx)
+                  if entry.tctx is not None else None)
         t0 = time.perf_counter()
         try:
             entry.result = manager._step_locked(entry.session, steps)
         except Exception as e:  # noqa: BLE001 — delivered to the waiter
             entry.error = e
         finally:
+            if ttoken is not None:
+                reset_trace_context(ttoken)
             reset_request_id(token)
         with self._lock:
             self.solo_steps += 1
@@ -271,10 +282,14 @@ class MicroBatcher:
         obs = manager.obs
         if obs is not None:
             # one dispatch serves B requests: the span lists every rid so
-            # any of them reconstructs this shared leg from the JSONL
+            # any of them reconstructs this shared leg from the JSONL;
+            # each rider's trace context rides as a *link*, never a
+            # parent — the shared dispatch belongs to no single trace
+            links = [e.tctx.link() for e in group if e.tctx is not None]
             obs.event("batched_dispatch", t2 - t1, t1, B=B, steps=steps,
                       sids=[e.session.id for e in group],
-                      request_ids=[e.rid for e in group])
+                      request_ids=[e.rid for e in group],
+                      **({"links": links} if links else {}))
             obs.occupancy_series.observe(B)
             if getattr(engine, "tuned_plan", None):
                 obs.dispatch_batched_tuned.observe(t2 - t1)
@@ -297,12 +312,17 @@ class MicroBatcher:
             s.grid = grid
             s.generation += steps
             s.batched_steps += 1
-            # commit under the submitter's request id so the checkpoint
-            # write's span carries it (this is the leader's thread)
+            # commit under the submitter's request id and trace context
+            # so the checkpoint write's span carries both (this is the
+            # leader's thread)
             token = set_request_id(e.rid)
+            ttoken = (set_trace_context(e.tctx)
+                      if e.tctx is not None else None)
             try:
                 manager._checkpoint(s)  # session lock is held (leader)
             finally:
+                if ttoken is not None:
+                    reset_trace_context(ttoken)
                 reset_request_id(token)
             manager._notify_step(s)
             e.result = {"id": s.id, "generation": s.generation,

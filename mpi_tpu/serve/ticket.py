@@ -57,6 +57,9 @@ from typing import Dict, List, Optional
 from mpi_tpu.obs.trace import (
     current_request_id, reset_request_id, set_request_id,
 )
+from mpi_tpu.obs.tracectx import (
+    current_trace_context, reset_trace_context, set_trace_context,
+)
 
 
 class TicketQueueFullError(RuntimeError):
@@ -69,11 +72,15 @@ class Ticket:
     exactly once; ``event`` wakes ``?wait=1`` pollers.  ``deadline``
     (a ``session._Deadline``) started counting at enqueue.  ``rid``
     carries the enqueuing request's id across the thread hop to the
-    dispatch loop, same as the MicroBatcher's ``_Entry.rid``."""
+    dispatch loop, same as the MicroBatcher's ``_Entry.rid``; ``tctx``
+    persists the minting trace context the same way, so the spans the
+    dispatch loop records for this ticket stitch under the enqueuing
+    request wherever it entered the cluster."""
 
     __slots__ = ("id", "sid", "steps", "remaining", "deadline", "status",
-                 "result", "error", "event", "rid", "enqueued_mono",
-                 "done_mono", "unit_rounds", "max_batched", "callbacks")
+                 "result", "error", "event", "rid", "tctx",
+                 "enqueued_mono", "done_mono", "unit_rounds",
+                 "max_batched", "callbacks")
 
     def __init__(self, tid: str, sid: str, steps: int, deadline):
         self.id = tid
@@ -86,6 +93,7 @@ class Ticket:
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
         self.rid = current_request_id()
+        self.tctx = current_trace_context()
         self.enqueued_mono = time.monotonic()
         self.done_mono: Optional[float] = None
         self.unit_rounds = 0            # device rounds this ticket rode in
@@ -369,8 +377,17 @@ class AsyncDispatcher:
                     f"({done} of {t.steps} steps dispatched; the session "
                     f"survives)"))
                 if manager.obs is not None:
-                    manager.obs.event("ticket_expired", sid=t.sid,
-                                      ticket=t.id, dispatched=done)
+                    # drained on the loop thread: re-enter the minting
+                    # context so the expiry is greppable by trace id
+                    ttoken = (set_trace_context(t.tctx)
+                              if t.tctx is not None else None)
+                    try:
+                        manager.obs.event("ticket_expired", sid=t.sid,
+                                          ticket=t.id, dispatched=done,
+                                          rid=t.rid)
+                    finally:
+                        if ttoken is not None:
+                            reset_trace_context(ttoken)
             else:
                 runnable.append(t)
         groups: Dict[int, list] = {}
@@ -494,10 +511,16 @@ class AsyncDispatcher:
                 return [t for t, _ in live]
             t2 = time.perf_counter()
             if obs is not None:
+                # every rider's trace context rides as a *link* — the
+                # shared round is related to each minting request, not
+                # parented under any one of them
+                links = [t.tctx.link() for t, _ in live
+                         if t.tctx is not None]
                 obs.event("unit_round", t2 - t1, t1, B=B, rounds=chain,
                           cohorts=len(set(rem)),
                           sids=[s.id for _, s in live],
-                          request_ids=[t.rid for t, _ in live])
+                          request_ids=[t.rid for t, _ in live],
+                          **({"links": links} if links else {}))
                 obs.occupancy_series.observe(B)
                 (obs.dispatch_batched if B > 1
                  else obs.dispatch_solo).observe(t2 - t1)
@@ -522,12 +545,17 @@ class AsyncDispatcher:
                 s.steady_s += per_board
                 if B > 1:
                     s.batched_steps += 1
-                # commit under the submitter's request id so the
-                # checkpoint write's span carries it (loop thread)
+                # commit under the submitter's request id AND trace
+                # context so the checkpoint write's span carries both
+                # (loop thread)
                 token = set_request_id(t.rid)
+                ttoken = (set_trace_context(t.tctx)
+                          if t.tctx is not None else None)
                 try:
                     manager._checkpoint(s)
                 finally:
+                    if ttoken is not None:
+                        reset_trace_context(ttoken)
                     reset_request_id(token)
                 manager._notify_step(s)
                 t.remaining = 0
@@ -560,6 +588,8 @@ class AsyncDispatcher:
         with self._cv:
             self.solo_tickets += 1
         token = set_request_id(ticket.rid)
+        ttoken = (set_trace_context(ticket.tctx)
+                  if ticket.tctx is not None else None)
         try:
             res = dict(manager.step(ticket.sid, ticket.remaining,
                                     _deadline=ticket.deadline,
@@ -577,6 +607,8 @@ class AsyncDispatcher:
                     self.tickets_expired += 1
             self._complete(ticket, error=e)
         finally:
+            if ttoken is not None:
+                reset_trace_context(ttoken)
             reset_request_id(token)
 
 

@@ -50,6 +50,10 @@ from mpi_tpu.cluster.proxy import (
 )
 from mpi_tpu.config import ConfigError
 from mpi_tpu.obs.trace import reset_request_id, set_request_id
+from mpi_tpu.obs.tracectx import (
+    TRACEPARENT_HEADER, current_trace_context, format_traceparent, mint,
+    parse_traceparent, reset_trace_context, set_trace_context, stitch_spans,
+)
 from mpi_tpu.serve import wire
 from mpi_tpu.serve.session import (
     DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
@@ -57,6 +61,10 @@ from mpi_tpu.serve.session import (
 )
 
 DEFAULT_MAX_BODY = 64 << 20             # 64 MiB
+
+# a scraper negotiates exemplar-capable output by naming this media type
+# in Accept; everything else gets the byte-identical Prometheus text
+OPENMETRICS_MEDIA_TYPE = "application/openmetrics-text"
 
 
 class Request:
@@ -162,8 +170,14 @@ class AppCore:
         else:
             # one shared id per request: every span recorded while this
             # request is handled — here, in the watchdog worker, in the
-            # batch leader — carries it (JSONL reconstructability)
+            # batch leader — carries it (JSONL reconstructability).  The
+            # trace context rides the same contextvar discipline: a
+            # proxied hop continues the remote trace off its traceparent
+            # header, anything else mints a fresh one at this edge.
+            tctx = parse_traceparent(
+                req.headers.get(TRACEPARENT_HEADER)) or mint()
             token = set_request_id(rid)
+            ttoken = set_trace_context(tctx)
             try:
                 with obs.span("http_request", method=req.method,
                               path=req.path) as sp:
@@ -171,7 +185,14 @@ class AppCore:
                     sp.tag(code=resp.code)
                 obs.http_requests.inc(method=req.method, code=resp.code)
             finally:
+                reset_trace_context(ttoken)
                 reset_request_id(token)
+            if not isinstance(resp, StreamPlan):
+                # echo the served identity (the http_request span, so a
+                # client following the /stream 307 re-propagates it and
+                # the owner's spans stitch under this hop)
+                resp.headers.append((TRACEPARENT_HEADER, format_traceparent(
+                    sp.ctx if sp.ctx is not None else tctx)))
         if not isinstance(resp, StreamPlan):
             self.count_out(len(resp.body), transport)
         if self.verbose:
@@ -254,6 +275,8 @@ class AppCore:
             return "usage", None, None
         if parts == ["debug", "profile"]:
             return "profile", None, None
+        if len(parts) == 3 and parts[:2] == ["debug", "trace"]:
+            return "trace", parts[2], None      # parts[2] is the trace id
         if parts and parts[0] == "cluster":
             # served only in cluster mode (self.cluster set); otherwise
             # falls through _handle to the usual structured 404
@@ -289,7 +312,11 @@ class AppCore:
                 TicketQueueFullError) as e:
             # fault-tolerance outcomes: the session survives; 503 tells
             # the client "try again / try later", never "you sent garbage"
-            return json_response(503, {"error": str(e), "request_id": rid})
+            payload = {"error": str(e), "request_id": rid}
+            ctx = current_trace_context()
+            if ctx is not None:
+                payload["trace_id"] = ctx.trace_id
+            return json_response(503, payload)
         except (ConfigError, ValueError) as e:
             return json_response(400, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — the structured-500 backstop
@@ -303,6 +330,11 @@ class AppCore:
                 "error": f"internal server error ({type(e).__name__})",
                 "request_id": rid,
             }
+            ctx = current_trace_context()
+            if ctx is not None:
+                # the client-side half of log↔trace correlation: this id
+                # keys GET /debug/trace/<trace_id> on any node
+                payload["trace_id"] = ctx.trace_id
             if obs is not None:
                 # flush the evidence: the ring (or live --trace-log)
                 # holds the request's spans up to the failure point
@@ -347,9 +379,22 @@ class AppCore:
             if obs is None:
                 return json_response(404, {
                     "error": "observability is disabled (--no-obs)"})
+            if OPENMETRICS_MEDIA_TYPE in (req.headers.get("Accept") or ""):
+                # negotiated upgrade only: exemplars ride OpenMetrics;
+                # the default Prometheus text stays byte-identical
+                text = obs.render_metrics(openmetrics=True)
+                return Response(
+                    200, text.encode("utf-8"),
+                    f"{OPENMETRICS_MEDIA_TYPE}; version=1.0.0; "
+                    f"charset=utf-8")
             text = obs.render_metrics()
             return Response(200, text.encode("utf-8"),
                             "text/plain; version=0.0.4; charset=utf-8")
+        if kind == "trace" and method == "GET" and sid is not None:
+            if obs is None:
+                return json_response(404, {
+                    "error": "observability is disabled (--no-obs)"})
+            return self._trace_fetch(req, sid)
         if kind == "usage" and method == "GET":
             # same off-switch contract as /metrics: usage metering rides
             # the obs handle, so --no-obs answers the same structured 404
@@ -501,6 +546,23 @@ class AppCore:
         if raw:
             headers["Content-Length"] = str(len(raw))
         headers.update(extra or {})
+        if self.obs is not None:
+            # the hop is itself a span; the traceparent sent carries ITS
+            # id, so the owner's http_request stitches under this hop
+            with self.obs.span("proxy_hop", peer=owner, method=req.method,
+                               path=req.path) as sp:
+                ctx = current_trace_context()
+                if ctx is not None:
+                    headers[TRACEPARENT_HEADER] = format_traceparent(ctx)
+                resp = self._proxy_send(owner, req, raw, headers, missing)
+                sp.tag(code=resp.code)
+            return resp
+        return self._proxy_send(owner, req, raw, headers, missing)
+
+    def _proxy_send(self, owner: str, req: Request, raw: bytes,
+                    headers: dict,
+                    missing: Optional[Tuple[str, str]]) -> Response:
+        cluster = self.cluster
         try:
             status, ctype, data = proxy_request(
                 owner, req.method, req.path, raw, headers,
@@ -515,6 +577,70 @@ class AppCore:
                                            "peer": owner})
             return json_response(503, {"error": str(e), "peer": owner})
         return Response(status, data, ctype)
+
+    # -- distributed trace assembly (GET /debug/trace/<trace_id>) ----------
+
+    def _trace_fragment(self, trace_id: str) -> List[dict]:
+        """This process's spans for one trace, node-stamped so stitched
+        output says where each span ran.  Shared dispatch rounds carry
+        riders as ``links`` (``trace_id:span_id``), not parents — a
+        round linked to this trace is part of the story, so it rides
+        along (it stitches as a root: related, unparented)."""
+        node = self.cluster.id if self.cluster is not None else "local"
+        prefix = trace_id + ":"
+        out = []
+        for rec in self.obs.tracer.snapshot():
+            if rec.get("trace_id") == trace_id or any(
+                    link.startswith(prefix)
+                    for link in rec.get("links") or ()):
+                rec["node"] = node
+                out.append(rec)
+        return out
+
+    def _trace_fetch(self, req: Request, trace_id: str) -> Response:
+        """Assemble one trace: the local fragment, plus (in cluster
+        mode, when this request was not itself a fan-out hop) each live
+        peer's fragment, stitched into one wall-clock-ordered tree.  A
+        peer that is down — or dies mid-fetch — lands in ``partial``;
+        the fetch itself never fails on a dead peer."""
+        cluster = self.cluster
+        forwarded = bool(req.headers.get(FORWARDED_HEADER))
+        fanout = cluster is not None and not forwarded
+        with self.obs.span("trace_fetch", target=trace_id, fanout=fanout):
+            spans = self._trace_fragment(trace_id)
+            nodes = [self.cluster.id if cluster is not None else "local"]
+            partial: List[str] = []
+            if fanout:
+                for addr, state in cluster.health_block()["peers"].items():
+                    if (not state["alive"]
+                            and state["last_seen_age_s"] is not None):
+                        # known-dead by heartbeat age: report, don't wait
+                        # on a connect timeout
+                        partial.append(addr)
+                        continue
+                    try:
+                        status, _, data = proxy_request(
+                            addr, "GET", f"/debug/trace/{trace_id}", b"",
+                            {FORWARDED_HEADER: cluster.id},
+                            timeout_s=cluster.timeout_s)
+                        frag = json.loads(data) if status == 200 else None
+                    except (PeerUnreachable, ValueError):
+                        frag = None
+                    if not isinstance(frag, dict):
+                        partial.append(addr)
+                        continue
+                    spans.extend(s for s in frag.get("spans", [])
+                                 if isinstance(s, dict))
+                    nodes.append(addr)
+            ordered, roots = stitch_spans(spans)
+        return json_response(200, {
+            "trace_id": trace_id,
+            "nodes": nodes,
+            "partial": partial,
+            "complete": not partial,
+            "spans": ordered,
+            "tree": roots,
+        })
 
     # -- wire-format helpers -----------------------------------------------
 

@@ -525,6 +525,67 @@ def test_live_usage_cluster_totals_equal_sum_of_processes():
         b.close()
 
 
+# ------------------------------------------------------- trace stitching
+
+
+def test_proxied_trace_stitches_across_nodes_and_degrades_partial():
+    """PR 13 in-process: a step proxied a->b yields a traceparent whose
+    ``/debug/trace`` fan-out at a stitches ONE tree holding both nodes'
+    spans; once b dies, the same fetch answers 200 with b in
+    ``partial`` instead of hanging or failing."""
+    a, b = _pair(with_obs=True)
+    try:
+        # a session owned by b, stepped through a: the proxied hop
+        sid = None
+        seed = 0
+        while sid is None:
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            assert st == 200
+            seed += 1
+            if a.node.owner_addr(out["id"]) == b.addr:
+                sid = out["id"]
+        st, out, hdrs = _req(a.addr, "POST", f"/sessions/{sid}/step",
+                             {"steps": 2})
+        assert st == 200 and out["generation"] == 2
+        tp = hdrs.get("X-Gol-Traceparent", "")
+        parts = tp.split("-")
+        assert len(parts) == 4 and len(parts[1]) == 32, tp
+        tid = parts[1]
+        st, doc, _ = _req(a.addr, "GET", f"/debug/trace/{tid}")
+        assert st == 200
+        assert doc["complete"] and not doc["partial"]
+        assert doc["nodes"] == [a.addr, b.addr]
+        names = {s["name"] for s in doc["spans"]}
+        assert {"http_request", "proxy_hop", "host_step"} <= names
+        by_node = {s["node"] for s in doc["spans"]}
+        assert by_node == {a.addr, b.addr}
+
+        # ONE tree: walk from a root and find spans of both nodes
+        def nodes_of(n, acc):
+            acc.add(n["node"])
+            for c in n["children"]:
+                nodes_of(c, acc)
+            return acc
+        assert any(len(nodes_of(r, set())) == 2 for r in doc["tree"])
+        # the hop parents the remote request span explicitly
+        hop = next(s for s in doc["spans"] if s["name"] == "proxy_hop")
+        remote_req = next(s for s in doc["spans"]
+                          if s["name"] == "http_request"
+                          and s["node"] == b.addr)
+        assert remote_req["parent_span_id"] == hop["span_id"]
+        # kill b: the same fetch degrades to the partial contract
+        b.close()
+        st, doc, _ = _req(a.addr, "GET", f"/debug/trace/{tid}")
+        assert st == 200
+        assert doc["partial"] == [b.addr] and not doc["complete"]
+        assert {s["node"] for s in doc["spans"]} == {a.addr}
+    finally:
+        a.close()
+        b.close()
+
+
 # ------------------------------------------------------- health + info
 
 
@@ -686,6 +747,80 @@ def test_two_process_group_serves_and_survives_a_kill(tmp_path):
             st, out, _ = _req(a, "POST", f"/sessions/{sid}/step",
                               {"steps": 1})
             assert st == 200, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+
+def test_two_process_stitched_trace(tmp_path):
+    """The PR 13 acceptance flow against REAL processes: a request
+    proxied front->owner with an async ticket yields ONE stitched tree
+    from ``GET /debug/trace/<trace_id>`` containing spans recorded by
+    both processes."""
+    procs = []
+    try:
+        for attempt in range(PORT_RETRIES):
+            p1, p2 = free_port(), free_port()
+            procs = [_spawn_serve(p1, p2, tmp_path, "n1"),
+                     _spawn_serve(p2, p1, tmp_path, "n2")]
+            time.sleep(0.5)
+            died = [p for p in procs if p.poll() is not None]
+            if died and attempt + 1 < PORT_RETRIES:
+                errs = "".join(p.communicate()[1] for p in died)
+                for p in procs:
+                    p.kill()
+                    p.communicate()
+                if bind_collision(errs):
+                    continue
+                raise AssertionError(f"serve process died:\n{errs[-2000:]}")
+            break
+        a, b = f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"
+        _wait_healthy(a)
+        _wait_healthy(b)
+        # hunt a session owned by process 2, async-stepped via front 1
+        # (the ticket tag names the owner, proving the proxied hop)
+        tid = None
+        seed = 0
+        while tid is None and seed < 32:
+            st, out, _ = _req(a, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            assert st == 200, out
+            seed += 1
+            st, t, hdrs = _req(a, "POST",
+                               f"/sessions/{out['id']}/step?async=1",
+                               {"steps": 1})
+            assert st == 200, t
+            st, res, _ = _req(a, "GET", f"/result/{t['ticket']}?wait=1")
+            assert st == 200 and res["status"] == "done", res
+            if t["ticket"].endswith(f"@{node_tag(b)}"):
+                tp = hdrs.get("X-Gol-Traceparent", "")
+                parts = tp.split("-")
+                assert len(parts) == 4 and len(parts[1]) == 32, tp
+                tid = parts[1]
+        assert tid is not None, "ring never placed a session on process 2"
+        st, doc, _ = _req(a, "GET", f"/debug/trace/{tid}")
+        assert st == 200
+        assert doc["complete"] and not doc["partial"], doc["partial"]
+        assert sorted(doc["nodes"]) == sorted([a, b])
+        names = {s["name"] for s in doc["spans"]}
+        assert {"http_request", "proxy_hop", "enqueue"} <= names, names
+        assert {s["node"] for s in doc["spans"]} == {a, b}
+
+        def nodes_of(n, acc):
+            acc.add(n["node"])
+            for c in n["children"]:
+                nodes_of(c, acc)
+            return acc
+        assert any(len(nodes_of(r, set())) == 2 for r in doc["tree"]), \
+            "no single stitched tree contains spans from both processes"
     finally:
         for p in procs:
             if p.poll() is None:

@@ -31,6 +31,10 @@ from mpi_tpu.obs.metrics import MetricsRegistry  # noqa: E402
 from mpi_tpu.obs.trace import (  # noqa: E402
     Tracer, current_request_id, reset_request_id, set_request_id,
 )
+from mpi_tpu.obs.tracectx import (  # noqa: E402
+    format_traceparent, mint, parse_traceparent, reset_trace_context,
+    set_trace_context, stitch_spans,
+)
 from mpi_tpu.serve.cache import EngineCache  # noqa: E402
 from mpi_tpu.serve.session import SessionManager  # noqa: E402
 from mpi_tpu.utils.timing import PhaseTimer, write_reports  # noqa: E402
@@ -147,6 +151,100 @@ def test_span_records_error_and_reraises():
             raise ValueError("nope")
     rec = tr.snapshot()[-1]
     assert rec["name"] == "boom" and "ValueError" in rec["error"]
+
+
+# ------------------------------------------------- trace context (PR 13)
+
+
+def test_traceparent_parse_format_round_trip():
+    ctx = mint()
+    assert len(ctx.trace_id) == 32 and ctx.span_id is None
+    back = parse_traceparent(format_traceparent(ctx))
+    assert back.trace_id == ctx.trace_id and back.span_id is None
+    # a child IS a span; its children parent to it
+    child = ctx.child()
+    assert len(child.span_id) == 16 and child.parent_span_id is None
+    grand = child.child()
+    assert grand.parent_span_id == child.span_id
+    assert grand.trace_id == ctx.trace_id
+    back = parse_traceparent(format_traceparent(child))
+    assert back.span_id == child.span_id
+
+
+def test_traceparent_rejects_malformed():
+    good = f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert parse_traceparent(good).trace_id == "ab" * 16
+    for bad in (None, "", "junk", good + "-extra",
+                f"00-XYZ-{'cd' * 8}-01",
+                f"00-{'ab' * 15}-{'cd' * 8}-01",        # short trace id
+                f"ff-{'ab' * 16}-{'cd' * 8}-01",        # reserved version
+                f"00-{'0' * 32}-{'cd' * 8}-01"):        # all-zero trace
+        assert parse_traceparent(bad) is None
+    # the null span id parses as "no parent span", not a rejection
+    anchored = parse_traceparent(f"00-{'ab' * 16}-{'0' * 16}-01")
+    assert anchored.trace_id == "ab" * 16 and anchored.span_id is None
+
+
+def test_trace_context_link():
+    ctx = mint()
+    assert ctx.link() == f"{ctx.trace_id}:{'0' * 16}"
+    child = ctx.child()
+    assert child.link() == f"{ctx.trace_id}:{child.span_id}"
+
+
+def test_stitch_spans_orders_and_trees():
+    recs = [
+        {"name": "leaf", "t_unix": 2.0, "seq": 3,
+         "trace_id": "t", "span_id": "bb", "parent_span_id": "aa"},
+        {"name": "root", "t_unix": 1.0, "seq": 1,
+         "trace_id": "t", "span_id": "aa"},
+        {"name": "orphan", "t_unix": 1.5, "seq": 2,
+         "trace_id": "t", "span_id": "cc", "parent_span_id": "zz"},
+    ]
+    ordered, roots = stitch_spans(recs)
+    assert [r["name"] for r in ordered] == ["root", "orphan", "leaf"]
+    # a parent that never reported -> the child surfaces as a root
+    assert sorted(r["name"] for r in roots) == ["orphan", "root"]
+    root = next(r for r in roots if r["name"] == "root")
+    assert [c["name"] for c in root["children"]] == ["leaf"]
+
+
+def test_trace_context_survives_breaker_and_degrade():
+    """PR 3's failure paths under the minted trace: the injected faults
+    trip the breaker (solo fallback retries), the session degrades to
+    serial_np, and EVERY record of the episode still carries the trace —
+    failure diagnostics are exactly when the stitched view matters."""
+    cache = EngineCache(max_size=4, breaker_threshold=3,
+                        breaker_cooldown_s=60.0)
+    obs = Obs()
+    mgr = SessionManager(cache, obs=obs, step_retries=2,
+                         retry_backoff_s=0.001, faults="step:1-3:raise")
+    sid = mgr.create(dict(TPU_SPEC))["id"]
+    ctx = mint()
+    token = set_trace_context(ctx)
+    try:
+        r = mgr.step(sid, 1)        # 3 failures -> breaker -> degrade
+    finally:
+        reset_trace_context(token)
+    assert r["generation"] == 1 and mgr.get(sid).degraded
+    recs = [r for r in obs.tracer.snapshot()
+            if r.get("trace_id") == ctx.trace_id]
+    names = {r["name"] for r in recs}
+    assert {"engine_failure", "degrade"} <= names
+    assert all(len(r["span_id"]) == 16 for r in recs)
+    # a degraded (serial_np) step under a fresh trace still records
+    # its host-path dispatch inside that trace
+    ctx2 = mint()
+    token = set_trace_context(ctx2)
+    try:
+        mgr.step(sid, 2)
+    finally:
+        reset_trace_context(token)
+    hosts = [r for r in obs.tracer.snapshot() if r["name"] == "host_step"]
+    assert hosts and hosts[-1]["trace_id"] == ctx2.trace_id
+    # and nothing recorded outside a context invents one
+    obs.tracer.event("bare")
+    assert "trace_id" not in obs.tracer.snapshot()[-1]
 
 
 # --------------------------------------------- manager + engine coverage
@@ -292,6 +390,20 @@ def _req(srv, method, path, body=None, raw=False):
         return e.code, json.loads(e.read())
 
 
+def _req_h(srv, method, path, body=None, headers=None):
+    host, port = srv.server_address[:2]
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
 def test_metrics_endpoint_and_trace_linkage(obs_server):
     srv, obs, trace_log = obs_server
     _, created = _req(srv, "POST", "/sessions", dict(TPU_SPEC))
@@ -320,6 +432,62 @@ def test_metrics_endpoint_and_trace_linkage(obs_server):
     assert n_recs > 0 and n_linked >= 2
 
 
+def test_debug_trace_endpoint_stitches_local_tree(obs_server):
+    """An incoming traceparent is continued: the served spans land under
+    the caller's trace id, parent to the caller's span id, and
+    ``GET /debug/trace/<id>`` answers the stitched single-node tree."""
+    srv, _, _ = obs_server
+    _, created, _ = _req_h(srv, "POST", "/sessions", dict(TPU_SPEC))
+    sid = created["id"]
+    want_tid, want_span = "ab" * 16, "cd" * 8
+    status, _, headers = _req_h(
+        srv, "POST", f"/sessions/{sid}/step", {"steps": 1},
+        headers={"X-Gol-Traceparent": f"00-{want_tid}-{want_span}-01"})
+    assert status == 200
+    assert want_tid in headers.get("X-Gol-Traceparent", "")
+    status, doc, _ = _req_h(srv, "GET", f"/debug/trace/{want_tid}")
+    assert status == 200
+    assert doc["complete"] and not doc["partial"]
+    assert doc["nodes"] == ["local"]
+    reqs = [s for s in doc["spans"] if s["name"] == "http_request"]
+    assert reqs and reqs[0]["parent_span_id"] == want_span
+    assert doc["tree"]
+    # dispatch work nests under the request span in the tree
+    req_node = next(n for n in doc["tree"]
+                    if n["name"] == "http_request")
+    assert req_node["children"]
+
+
+def test_watchdog_timeout_503_carries_trace_and_request_ids():
+    """PR 3's watchdog deadline under tracing: the 503 body pairs
+    ``trace_id`` with ``request_id`` and the response traceparent
+    carries the same trace — a timed-out request stays findable."""
+    from mpi_tpu.serve.httpd import make_server
+
+    mgr = SessionManager(EngineCache(max_size=4), obs=Obs(),
+                         request_timeout_s=0.3, step_retries=0,
+                         faults="step:1:hang:1.0")
+    srv = make_server(port=0, manager=mgr)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        _, created, _ = _req_h(srv, "POST", "/sessions",
+                               dict(TPU_SPEC, seed=53))
+        sid = created["id"]
+        status, body, headers = _req_h(
+            srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+        assert status == 503
+        assert "request_id" in body
+        tid = body.get("trace_id")
+        assert tid and len(tid) == 32
+        assert tid in headers.get("X-Gol-Traceparent", "")
+        assert mgr.watchdog_timeouts == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        thread.join(timeout=5)
+
+
 def test_metrics_404_when_obs_disabled():
     from mpi_tpu.serve.httpd import make_server
 
@@ -329,6 +497,8 @@ def test_metrics_404_when_obs_disabled():
     thread.start()
     try:
         status, body = _req(srv, "GET", "/metrics")
+        assert status == 404 and "--no-obs" in body["error"]
+        status, body = _req(srv, "GET", f"/debug/trace/{'ab' * 16}")
         assert status == 404 and "--no-obs" in body["error"]
     finally:
         srv.shutdown()

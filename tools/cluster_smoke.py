@@ -7,7 +7,9 @@ order:
 1. **sticky routing / transparent proxy** — serial sessions created
    through both fronts; every session then steps and snapshots through
    the front that does NOT own it, and both fronts return identical
-   boards;
+   boards; a proxied async step then yields an ``X-Gol-Traceparent``
+   whose ``GET /debug/trace/<trace_id>`` stitches ONE tree containing
+   spans from both processes (the PR-13 acceptance flow);
 2. **breaker gossip** — both processes run ``--inject-faults
    'step:1:raise' --breaker-threshold 1``, so the first dispatch of a
    tpu-backend session opens the owner's breaker; the smoke waits at
@@ -19,8 +21,10 @@ order:
    catches up);
 4. **kill one process** — the survivor answers structured 404s
    (``{"error": "no ticket ...", "peer": ...}``) for the dead peer's
-   tickets and its ``/healthz`` flips the peer to down, while ``ok``
-   stays true and locally-owned sessions keep serving.
+   tickets, ``GET /debug/trace`` for the stage-1 trace answers 200
+   with the dead peer named in ``partial`` (no hang, no 500), and its
+   ``/healthz`` flips the peer to down, while ``ok`` stays true and
+   locally-owned sessions keep serving.
 
 Exit-code contract (shared with the other ``tools/ci_gate.sh`` stages):
 0 clean, 1 findings, 2 internal error.  Needs jax only inside the
@@ -32,6 +36,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -46,19 +51,26 @@ from mpi_tpu.utils.net import (                           # noqa: E402
 
 FAULTS = "step:1:raise"
 GOSSIP_S = 0.25
+TRACEPARENT = re.compile(r"^00-([0-9a-f]{32})-[0-9a-f]{16}-01$")
 
 
 def _req(addr, method, path, body=None):
+    st, out, _ = _req_h(addr, method, path, body)
+    return st, out
+
+
+def _req_h(addr, method, path, body=None):
     conn = http.client.HTTPConnection(addr, timeout=30)
     payload = json.dumps(body).encode() if body is not None else None
     conn.request(method, path, body=payload)
     resp = conn.getresponse()
     data = resp.read()
+    hdrs = dict(resp.getheaders())
     conn.close()
     try:
-        return resp.status, json.loads(data)
+        return resp.status, json.loads(data), hdrs
     except (ValueError, UnicodeDecodeError):
-        return resp.status, data
+        return resp.status, data, hdrs
 
 
 def _spawn(port, peer_port):
@@ -159,6 +171,64 @@ def main() -> int:
             check(st1 == st2 == 200 and s1 == s2,
                   f"snapshot {sid} identical through both fronts")
 
+        # -- 1b: distributed trace stitched across the hop ---------------
+        print("stage 1b: cross-process trace stitching")
+        # hunt for a session OWNED by process 2 and step it with an
+        # async ticket through front 1 — the proxied path the tracing
+        # tentpole must stitch (ticket ids carry the owner's tag, so
+        # @tag(b) on a ticket minted via front a proves the hop)
+        tid = None
+        extra = 0
+        probe = list(sids)
+        while tid is None and extra < 32:
+            if not probe:
+                st, out = _req(a, "POST", "/sessions",
+                               {"rows": 16, "cols": 16,
+                                "backend": "serial", "seed": 90 + extra})
+                extra += 1
+                if st != 200:
+                    continue
+                probe.append(out["id"])
+            sid = probe.pop()
+            st, t, hdrs = _req_h(a, "POST",
+                                 f"/sessions/{sid}/step?async=1",
+                                 {"steps": 1})
+            if st != 200:
+                continue
+            st, res = _req(a, "GET", f"/result/{t['ticket']}?wait=1")
+            if st != 200 or res.get("status") != "done":
+                continue
+            if t["ticket"].endswith(f"@{node_tag(b)}"):
+                m = TRACEPARENT.match(hdrs.get("X-Gol-Traceparent", ""))
+                check(m is not None,
+                      f"proxied async step answered a well-formed "
+                      f"traceparent "
+                      f"({hdrs.get('X-Gol-Traceparent')!r})")
+                tid = m.group(1) if m else None
+        if not check(tid is not None,
+                     "a proxied async step onto process 2 yielded a "
+                     "trace id"):
+            return 1
+        st, doc = _req(a, "GET", f"/debug/trace/{tid}")
+        check(st == 200 and doc.get("complete")
+              and not doc.get("partial"),
+              f"/debug/trace complete with both peers alive "
+              f"({doc.get('partial')})")
+        names = {s.get("name") for s in doc.get("spans") or []}
+        check("proxy_hop" in names and "http_request" in names,
+              f"stitched trace carries the hop span ({sorted(names)})")
+        check(set(doc.get("nodes") or []) == {a, b},
+              f"fragments came from both processes ({doc.get('nodes')})")
+
+        def _subtree_nodes(n, acc):
+            acc.add(n.get("node"))
+            for c in n.get("children") or ():
+                _subtree_nodes(c, acc)
+            return acc
+        check(any(len(_subtree_nodes(r, set())) >= 2
+                  for r in doc.get("tree") or ()),
+              "one stitched tree contains spans from both processes")
+
         # -- 2: breaker opens on the owner, gossips to the peer ----------
         print("stage 2: breaker gossip")
         st, out = _req(a, "POST", "/sessions",
@@ -251,6 +321,17 @@ def main() -> int:
             return 1
         procs[1].kill()
         procs[1].communicate()
+        # the stage-1b trace has spans on the dead process: the fetch
+        # must answer 200 with the survivor's fragment and name the
+        # dead peer in ``partial`` — never hang, never 500
+        st, doc = _req(a, "GET", f"/debug/trace/{tid}")
+        check(st == 200 and doc.get("partial") == [b]
+              and not doc.get("complete"),
+              f"trace fetch after the kill honors the partial contract "
+              f"(partial={doc.get('partial')}, "
+              f"complete={doc.get('complete')})")
+        check(any(s.get("node") == a for s in doc.get("spans") or []),
+              "the survivor's fragment still answers after the kill")
         st, err = _req(a, "GET", f"/result/{t2}")
         check(st == 404 and err.get("error") == f"no ticket {t2!r}"
               and err.get("peer") == b,

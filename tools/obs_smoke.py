@@ -62,8 +62,18 @@ ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 SPARSE_SPAN_KINDS = {"sparse_step"}
 # ...and the aio stream push path (PR 7)
 WIRE_SPAN_KINDS = {"stream_push"}
+# ...and the cluster trace-assembly path (PR 13).  The fan-out halves
+# are exercised for real by tools/cluster_smoke.py's 2-process stages;
+# listing them here pins them as genuinely emitted kinds in the lint
+CLUSTER_SPAN_KINDS = {"proxy_hop", "trace_fetch"}
 # every trace record must carry exactly these core keys
 TRACE_KEYS = {"seq", "name", "t_unix", "t_mono", "dur_s", "thread"}
+# schema-v2 distributed trace context (PR 13): optional on every record
+# — present iff the record was made under a traced request, exactly
+# like rid.  The obs-drift lint cross-checks this literal against
+# mpi_tpu/obs/tracectx.py, so it cannot silently drift
+TRACE_CTX_KEYS = ("trace_id", "span_id", "parent_span_id")
+TRACEPARENT = re.compile(r"^00-[0-9a-f]{32}-[0-9a-f]{16}-01$")
 
 _SAMPLE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
@@ -145,6 +155,24 @@ def check_trace(path, require_async=False, require_sparse=False,
             if missing:
                 raise ValueError(f"trace line {ln} missing {sorted(missing)}:"
                                  f" {rec}")
+            # trace context is all-or-nothing (parent optional) and the
+            # ids are fixed-width hex — schema v2's wire contract
+            if ("span_id" in rec or "parent_span_id" in rec) \
+                    and "trace_id" not in rec:
+                raise ValueError(f"trace line {ln} has span ids without a "
+                                 f"trace_id: {rec}")
+            if "trace_id" in rec:
+                if not re.fullmatch(r"[0-9a-f]{32}", rec["trace_id"]):
+                    raise ValueError(f"trace line {ln} malformed trace_id: "
+                                     f"{rec['trace_id']!r}")
+                if not re.fullmatch(r"[0-9a-f]{16}",
+                                    rec.get("span_id") or ""):
+                    raise ValueError(f"trace line {ln} traced record "
+                                     f"lacks a 16-hex span_id: {rec}")
+            if "parent_span_id" in rec and not re.fullmatch(
+                    r"[0-9a-f]{16}", rec["parent_span_id"]):
+                raise ValueError(f"trace line {ln} malformed "
+                                 f"parent_span_id: {rec}")
             recs.append(rec)
     seqs = [r["seq"] for r in recs]
     if sorted(seqs) != seqs:
@@ -161,6 +189,20 @@ def check_trace(path, require_async=False, require_sparse=False,
         raise ValueError(
             "no request id links an http_request span to a dispatch span; "
             f"rids seen: { {k: sorted(v) for k, v in by_rid.items()} }")
+    # every http_request span is the edge: the context is minted there,
+    # so a context-free http_request record is a propagation hole
+    bare = [r for r in recs
+            if r["name"] == "http_request" and "trace_id" not in r]
+    if bare:
+        raise ValueError(f"{len(bare)} http_request record(s) carry no "
+                         f"trace context: {bare[:2]}")
+    # ...and the context threads DOWN: some span must parent to an
+    # http_request span (the in-process half of cross-node stitching)
+    http_spans = {r["span_id"] for r in recs
+                  if r["name"] == "http_request" and "span_id" in r}
+    if not any(r.get("parent_span_id") in http_spans for r in recs):
+        raise ValueError("no span parents to an http_request span — the "
+                         "trace context is not threading downstream")
     if require_async:
         seen_kinds = {r["name"] for r in recs}
         missing_kinds = ASYNC_SPAN_KINDS - seen_kinds
@@ -391,6 +433,63 @@ def main():
             aio_srv.server_close()
             aio_thread.join(timeout=10)
 
+        # -- distributed trace context (PR 13) -------------------------
+        # instrumented responses echo a well-formed traceparent; an
+        # incoming one is CONTINUED (same trace id, served spans parent
+        # to the remote span id — the single-process half of the
+        # cross-process stitching contract); /debug/trace answers the
+        # stitched fragment; exemplars render only under OpenMetrics
+        # negotiation, never in the default text
+        def call_h(method, path, body=None, headers=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(base + path, data=data,
+                                         method=method)
+            for k, v in (headers or {}).items():
+                req.add_header(k, v)
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, dict(resp.headers), resp.read().decode()
+
+        _, hdrs, _ = call_h("GET", "/healthz")
+        tp = hdrs.get("X-Gol-Traceparent", "")
+        if not TRACEPARENT.match(tp):
+            raise ValueError(f"response traceparent malformed: {tp!r}")
+        want_tid, want_span = "ab" * 16, "cd" * 8
+        code, hdrs, _ = call_h(
+            "POST", f"/sessions/{sid_a}/step", {"steps": 1},
+            headers={"X-Gol-Traceparent": f"00-{want_tid}-{want_span}-01"})
+        assert code == 200, f"traced step -> {code}"
+        echoed = hdrs.get("X-Gol-Traceparent", "")
+        if want_tid not in echoed:
+            raise ValueError(f"incoming traceparent not continued: "
+                             f"{echoed!r}")
+        _, _, body = call_h("GET", f"/debug/trace/{want_tid}")
+        doc = json.loads(body)
+        if doc["partial"] or not doc["complete"]:
+            raise ValueError(f"single-process trace fetch not complete: "
+                             f"{doc['partial']}")
+        reqs = [r for r in doc["spans"] if r["name"] == "http_request"]
+        if not reqs:
+            raise ValueError(f"/debug/trace/{want_tid} lacks the "
+                             f"http_request span: "
+                             f"{[r['name'] for r in doc['spans']]}")
+        if reqs[0].get("parent_span_id") != want_span:
+            raise ValueError(
+                f"continued trace did not parent to the remote span: "
+                f"{reqs[0].get('parent_span_id')!r} != {want_span!r}")
+        if not doc["tree"]:
+            raise ValueError("trace fetch stitched no tree")
+        _, hdrs, text_om = call_h(
+            "GET", "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        if "openmetrics-text" not in hdrs.get("Content-Type", ""):
+            raise ValueError(f"OpenMetrics negotiation not honored: "
+                             f"{hdrs.get('Content-Type')!r}")
+        if ' # {trace_id="' not in text_om:
+            raise ValueError("OpenMetrics scrape carries no exemplars "
+                             "after traced dispatches")
+        if not text_om.rstrip().endswith("# EOF"):
+            raise ValueError("OpenMetrics scrape is not EOF-terminated")
+
         # -- usage ledger + cost cards (PR 10) -------------------------
         # every dispatch kind the traffic above exercised must have
         # metered: solo steps, the coalesced batched pairs, the async
@@ -432,6 +531,10 @@ def main():
 
         code, text = call("GET", "/metrics")   # final request; the counter
         assert code == 200, f"/metrics -> {code}"  # increments post-render
+        if " # {" in text:
+            raise ValueError("default /metrics text leaked OpenMetrics "
+                             "exemplars — Prometheus output must stay "
+                             "byte-identical without negotiation")
         types, samples = parse_prometheus(text)
         # family presence from the TYPE lines — the registry emits them
         # even for a histogram no traffic has touched yet

@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""ASCII waterfall for stitched distributed traces (stdlib only).
+
+Two sources, one renderer:
+
+* fetch-by-id — ``trace_view.py <trace_id> --url http://host:port``
+  asks the serving front's ``GET /debug/trace/<trace_id>``, which in
+  cluster mode already fans out to live peers and stitches the
+  fragments (dead peers show up in the ``partial`` banner here);
+* ``--from-jsonl trace.jsonl`` — offline over a ``--trace-log`` file
+  (or a ``dump_on_crash`` flush): the matching records are stitched
+  locally with the same tree rules the server uses.
+
+Either way the output is one wall-clock-aligned waterfall: indent is
+tree depth, the bar is the span's position and extent in the trace's
+total window, and the node column says which process recorded it — a
+slow proxied async step reads as "the gap is in the hop" or "the gap
+is in the owner's round" at a glance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_tpu.obs.tracectx import stitch_spans  # noqa: E402
+
+NAME_W = 36
+NODE_W = 18
+
+
+def fetch(url: str, trace_id: str) -> dict:
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/debug/trace/{trace_id}")
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def from_jsonl(path: str, trace_id: str) -> dict:
+    """Stitch the file's records for one trace — the ``trace_id`` keys
+    plus any shared dispatch round *linked* to it (``links`` entries are
+    ``trace_id:span_id``)."""
+    prefix = trace_id + ":"
+    spans = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue        # half-written tail line: skip, not fail
+            if rec.get("trace_id") == trace_id or any(
+                    link.startswith(prefix)
+                    for link in rec.get("links") or ()):
+                rec.setdefault("node", "jsonl")
+                spans.append(rec)
+    ordered, roots = stitch_spans(spans)
+    return {"trace_id": trace_id, "nodes": ["jsonl"], "partial": [],
+            "complete": True, "spans": ordered, "tree": roots}
+
+
+def _fmt_dur(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def render(doc: dict, width: int = 100) -> str:
+    spans = doc.get("spans") or []
+    out = [f"trace {doc.get('trace_id', '?')} · {len(spans)} span(s) · "
+           f"nodes: {', '.join(str(n) for n in doc.get('nodes') or [])}"]
+    for peer in doc.get("partial") or ():
+        out.append(f"  PARTIAL: no fragment from {peer} "
+                   f"(down or unreachable)")
+    if not spans:
+        out.append("  (no spans recorded under this trace id)")
+        return "\n".join(out)
+    t0 = min(s.get("t_unix", 0.0) for s in spans)
+    t1 = max(s.get("t_unix", 0.0) + (s.get("dur_s") or 0.0) for s in spans)
+    total = max(t1 - t0, 1e-9)
+    bar_w = max(16, width - (NAME_W + NODE_W + 12))
+    out.append(f"total {_fmt_dur(total)}")
+    out.append(f"{'span':<{NAME_W}} {'node':<{NODE_W}} {'dur':>8} "
+               f"|{'-' * bar_w}|")
+
+    def emit(node: dict, depth: int) -> None:
+        name = ("  " * depth + str(node.get("name", "?")))[:NAME_W]
+        dur = node.get("dur_s") or 0.0
+        a = int((node.get("t_unix", t0) - t0) / total * bar_w)
+        a = min(max(a, 0), bar_w - 1)
+        b = max(1, min(int(dur / total * bar_w), bar_w - a))
+        bar = " " * a + "=" * b + " " * (bar_w - a - b)
+        out.append(f"{name:<{NAME_W}} {str(node.get('node', '')):<{NODE_W}} "
+                   f"{_fmt_dur(dur):>8} |{bar}|")
+        for child in node.get("children") or ():
+            emit(child, depth + 1)
+
+    for root in doc.get("tree") or ():
+        emit(root, 0)
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render one stitched trace as an ASCII waterfall")
+    ap.add_argument("trace_id", help="32-hex trace id (from an "
+                    "X-Gol-Traceparent header or an error body)")
+    ap.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="serving front to fetch the stitched trace from")
+    ap.add_argument("--from-jsonl", dest="from_jsonl", metavar="PATH",
+                    default=None,
+                    help="stitch offline from a --trace-log JSONL file "
+                         "instead of fetching")
+    ap.add_argument("--width", type=int, default=100,
+                    help="total output width (default 100)")
+    args = ap.parse_args(argv)
+    try:
+        doc = (from_jsonl(args.from_jsonl, args.trace_id)
+               if args.from_jsonl else fetch(args.url, args.trace_id))
+    except urllib.error.HTTPError as e:
+        print(f"error: {args.url} answered {e.code}: "
+              f"{e.read().decode(errors='replace')}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(render(doc, width=args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

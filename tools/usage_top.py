@@ -8,9 +8,14 @@ Stdlib only (it talks to the same JSON surface the dashboards do):
     python tools/usage_top.py --url localhost:8000
     python tools/usage_top.py --url localhost:8000 --interval 2 --top 10
     python tools/usage_top.py --url localhost:8000 --once   # one snapshot
+    python tools/usage_top.py --url localhost:8000 --cluster  # slice view
 
-Exits 1 when the server answers 404 (``--no-obs`` — there is no ledger
-to watch) or stops answering.
+``--cluster`` renders the PR-12 ``cluster`` block: one row per node
+(each peer's latest gossiped cumulative totals) plus the exact roll-up
+row the server computed (``cluster.totals`` verbatim — this tool never
+re-derives the sum).  Exits 1 when the server answers 404 (``--no-obs``
+— there is no ledger to watch), stops answering, or ``--cluster`` is
+asked of a server running without ``--peers``.
 """
 
 from __future__ import annotations
@@ -37,6 +42,34 @@ def _fmt_big(v: float) -> str:
         if abs(v) >= div:
             return f"{v / div:.2f}{unit}"
     return f"{v:.0f}"
+
+
+def _cluster_row(label: str, tot: dict) -> str:
+    kinds = ", ".join(f"{k}={v}" for k, v in (tot.get("by_kind") or {}).items()
+                      if v) or "-"
+    return (f"{label:<24} {tot['syncs']:>6} {_fmt_s(tot['device_s']):>9} "
+            f"{_fmt_s(tot['host_s']):>9} {tot['generations']:>8} "
+            f"{_fmt_big(tot['cells']):>8} {_fmt_big(tot['flops']):>8} "
+            f"{kinds}")
+
+
+def render_cluster(cluster: dict) -> str:
+    """Per-node columns plus the server's own roll-up row (rendered
+    from ``cluster['totals']`` verbatim, never re-summed here)."""
+    lines = [
+        f"cluster @ {cluster['node']} — {cluster['nodes']} node(s), "
+        f"{cluster['nodes_reporting']} reporting",
+        f"{'node':<24} {'syncs':>6} {'device':>9} {'host':>9} "
+        f"{'gens':>8} {'cells':>8} {'flops':>8} by_kind",
+    ]
+    for addr in sorted(cluster.get("by_node") or {}):
+        tot = cluster["by_node"][addr]
+        if not tot:
+            lines.append(f"{addr:<24} (not reporting — no digest yet)")
+        else:
+            lines.append(_cluster_row(addr, tot))
+    lines.append(_cluster_row("TOTAL", cluster["totals"]))
+    return "\n".join(lines)
 
 
 def render(usage: dict, top: int) -> str:
@@ -93,6 +126,9 @@ def main(argv=None) -> int:
                     help="session rows to show (default 20)")
     ap.add_argument("--once", action="store_true",
                     help="one snapshot, no polling loop")
+    ap.add_argument("--cluster", action="store_true",
+                    help="render the /usage cluster block (per-node "
+                         "columns + the server's roll-up row)")
     args = ap.parse_args(argv)
     base = args.url if args.url.startswith("http") else f"http://{args.url}"
     while True:
@@ -106,8 +142,15 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"usage_top: cannot reach {base}: {e}", file=sys.stderr)
             return 1
+        if args.cluster and not usage.get("cluster"):
+            print(f"usage_top: {base}/usage has no cluster block "
+                  f"(server started without --peers)", file=sys.stderr)
+            return 1
         if not args.once:
             print("\x1b[2J\x1b[H", end="")     # clear, home
+        if args.cluster:
+            print(render_cluster(usage["cluster"]))
+            print()
         print(render(usage, args.top), flush=True)
         if args.once:
             return 0

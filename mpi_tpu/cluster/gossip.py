@@ -25,31 +25,56 @@ from mpi_tpu.cluster.proxy import (
 )
 
 GOSSIP_PATH = "/cluster/gossip"
+JOIN_PATH = "/cluster/join"
+ADOPT_PATH = "/cluster/adopt"
 
 
-def send_digest(addr: str, digest: dict, timeout_s: float = 5.0) -> dict:
-    """POST ``digest`` to one peer; returns the peer's reply (its own
-    digest rides in ``reply["digest"]``).  Raises
-    :class:`~mpi_tpu.cluster.proxy.PeerUnreachable` on transport
+def _post_json(addr: str, path: str, payload: dict, timeout_s: float,
+               sender: str) -> dict:
+    """POST one cluster-protocol message and parse the JSON reply.
+    Raises :class:`~mpi_tpu.cluster.proxy.PeerUnreachable` on transport
     failure and on a non-JSON or non-200 answer (a peer that cannot
     speak the protocol is as gone as one that cannot speak at all)."""
-    body = json.dumps(digest).encode()
+    body = json.dumps(payload).encode()
     status, _, data = proxy_request(
-        addr, "POST", GOSSIP_PATH, body,
-        # gossip must never be re-routed by the receiving core
-        headers={FORWARDED_HEADER: digest.get("node", "?"),
+        addr, "POST", path, body,
+        # protocol messages must never be re-routed by the receiving core
+        headers={FORWARDED_HEADER: sender,
                  "Content-Type": "application/json",
                  "Content-Length": str(len(body))},
         timeout_s=timeout_s)
     if status != 200:
-        raise PeerUnreachable(f"peer {addr} answered {status} to gossip")
+        raise PeerUnreachable(f"peer {addr} answered {status} to {path}")
     try:
         reply = json.loads(data)
     except ValueError as e:
-        raise PeerUnreachable(f"peer {addr} sent non-JSON gossip reply: {e}")
+        raise PeerUnreachable(f"peer {addr} sent non-JSON reply "
+                              f"to {path}: {e}")
     if not isinstance(reply, dict):
-        raise PeerUnreachable(f"peer {addr} sent malformed gossip reply")
+        raise PeerUnreachable(f"peer {addr} sent malformed reply to {path}")
     return reply
+
+
+def send_digest(addr: str, digest: dict, timeout_s: float = 5.0) -> dict:
+    """POST ``digest`` to one peer; returns the peer's reply (its own
+    digest rides in ``reply["digest"]``)."""
+    return _post_json(addr, GOSSIP_PATH, digest, timeout_s,
+                      digest.get("node", "?"))
+
+
+def send_join(addr: str, node: str, timeout_s: float = 5.0) -> dict:
+    """Announce ``node`` to an existing member (``POST /cluster/join``).
+    The reply carries the member's digest, so one successful join
+    teaches the joiner the whole membership in a single round."""
+    return _post_json(addr, JOIN_PATH, {"node": node}, timeout_s, node)
+
+
+def send_adopt(addr: str, node: str, sids: list,
+               timeout_s: float = 5.0) -> dict:
+    """Ask a ring successor to adopt ``sids`` from the shared state dir
+    (the drain handoff: ``POST /cluster/adopt``)."""
+    return _post_json(addr, ADOPT_PATH, {"sids": list(sids), "from": node},
+                      timeout_s, node)
 
 
 class Gossiper:
@@ -71,6 +96,13 @@ class Gossiper:
         self._thread.start()
 
     def _loop(self) -> None:
+        try:
+            # announce ourselves before the first round (off the caller's
+            # thread: startup must never block on a peer that is itself
+            # still starting up)
+            self._node.join_cluster()
+        except Exception:  # noqa: BLE001 — join is best-effort
+            pass
         while not self._stop.wait(self.interval_s):
             try:
                 self._node.gossip_now()

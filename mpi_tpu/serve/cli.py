@@ -150,6 +150,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--peer-timeout-s", type=float, default=5.0,
                    help="socket timeout for proxied requests and gossip "
                    "sends to a peer")
+    p.add_argument("--peer-down-s", type=float, default=None,
+                   help="heartbeat silence before a peer is reported "
+                   "down/suspect (default 3x the gossip interval)")
+    p.add_argument("--peer-dead-s", type=float, default=None,
+                   help="heartbeat silence before a peer is CONFIRMED "
+                   "dead: removed from the ring, its sessions adopted "
+                   "from the shared --state-dir (default 3x --peer-down-s)")
+    p.add_argument("--proxy-retries", type=int, default=2,
+                   help="retries (doubling backoff) for an unreachable "
+                   "peer on idempotent proxied verbs (GET); "
+                   "non-idempotent verbs always fail fast")
+    p.add_argument("--proxy-backoff-ms", type=float, default=50.0,
+                   help="initial proxy retry backoff, doubling per attempt")
+    p.add_argument("--proxy-timeout-s", type=float, default=None,
+                   help="socket timeout per proxy hop attempt "
+                   "(default: --peer-timeout-s)")
     return p
 
 
@@ -175,6 +191,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 
         tune_cache = (os.path.join(args.state_dir, "tune_cache.json")
                       if args.state_dir else default_cache_path())
+    cluster_mode = (args.peers is not None or args.peers_file is not None)
     try:
         manager = SessionManager(
             EngineCache(max_size=args.cache_size,
@@ -195,6 +212,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             faults=faults,
             obs=obs,
             tune_cache=tune_cache,
+            # cluster mode shares --state-dir across nodes: restore is
+            # deferred to attach_cluster, which takes only owned records
+            defer_restore=cluster_mode and args.state_dir is not None,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -214,7 +234,6 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                              max_body=args.http_max_body)
     host, port = server.server_address[:2]
     node = None
-    cluster_mode = (args.peers is not None or args.peers_file is not None)
     if cluster_mode:
         import socket
 
@@ -239,6 +258,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             node = ClusterNode(advertise, peers, manager,
                                interval_s=args.gossip_interval_s,
                                timeout_s=args.peer_timeout_s,
+                               down_after_s=args.peer_down_s,
+                               dead_after_s=args.peer_dead_s,
+                               proxy_retries=args.proxy_retries,
+                               proxy_backoff_s=args.proxy_backoff_ms / 1e3,
+                               proxy_timeout_s=args.proxy_timeout_s,
                                state_dir=args.state_dir, obs=obs)
         except ValueError as e:        # ConfigError included
             print(f"error: {e}", file=sys.stderr)

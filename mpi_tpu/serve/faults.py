@@ -20,28 +20,43 @@ failures can also corrupt the donated input buffer; the degradation
 path never trusts the device grid for exactly that reason — it replays
 from the last checkpoint instead.)
 
+The cluster layer (``mpi_tpu/cluster``) hooks the same plans at its two
+network seams: ``gossip`` (one outbound digest send per peer per round)
+and ``proxy`` (one outbound forwarded-request attempt, retries
+included).  Network sites get network modes — ``drop`` severs that one
+attempt (the caller sees the peer as unreachable), ``delay`` sleeps
+then proceeds, and ``partition`` drops outbound *and* cuts inbound at
+the same site (:meth:`FaultInjector.inbound_cut`) while the clause
+still covers the next outbound ordinal — a deterministic, symmetric
+network split that heals exactly when the clause range is spent.
+
 Spec grammar (comma-separated clauses; a leading ``seed=N`` clause
 seeds the probabilistic selector)::
 
     SPEC   := [ 'seed=' int ',' ] clause ( ',' clause )*
     clause := site ':' sel ':' mode [ ':' seconds ]
-    site   := 'step' | 'batched' | 'any'
+    site   := 'step' | 'batched' | 'any' | 'gossip' | 'proxy'
     sel    := N | N'+' | N'-'M | '*' | 'p'FLOAT
-    mode   := 'raise' | 'hang' | 'delay'
+    mode   := 'raise' | 'hang' | 'delay'          (engine sites)
+            | 'drop' | 'delay' | 'partition'      (network sites)
 
 ``sel`` counts dispatches at that site from 1 (``any`` counts both
-sites together): ``3`` fires on exactly the 3rd dispatch, ``3+`` from
-the 3rd on, ``2-4`` on the 2nd through 4th, ``*`` on every one, and
-``p0.25`` on each with probability 0.25 drawn from a ``random.Random``
-seeded by the plan's ``seed=`` clause (default 0) — same seed, same
-dispatch order, same faults, every run.  ``seconds`` defaults to 30 for
-``hang`` and 0.05 for ``delay``; ``raise`` ignores it.
+engine sites together; network sites each count alone): ``3`` fires on
+exactly the 3rd dispatch, ``3+`` from the 3rd on, ``2-4`` on the 2nd
+through 4th, ``*`` on every one, and ``p0.25`` on each with probability
+0.25 drawn from a ``random.Random`` seeded by the plan's ``seed=``
+clause (default 0) — same seed, same dispatch order, same faults, every
+run.  ``seconds`` defaults to 30 for ``hang`` and 0.05 for ``delay``;
+``raise``, ``drop``, and ``partition`` ignore it.
 
 Examples::
 
     --inject-faults 'step:1-3:raise'       # first three solo dispatches fail
     --inject-faults 'any:2:hang:5'         # 2nd dispatch wedges for 5 s
     --inject-faults 'seed=7,step:p0.1:raise'
+    --inject-faults 'gossip:1-8:partition' # both gossip directions cut until
+                                           # 8 outbound sends have been eaten
+    --inject-faults 'proxy:1:drop'         # first proxy hop fails (retry path)
 """
 
 from __future__ import annotations
@@ -54,14 +69,25 @@ from typing import List, Optional, Tuple
 
 from mpi_tpu.config import ConfigError
 
-_SITES = ("step", "batched", "any")
-_MODES = ("raise", "hang", "delay")
-_DEFAULT_SECONDS = {"raise": 0.0, "hang": 30.0, "delay": 0.05}
+_ENGINE_SITES = ("step", "batched", "any")
+_NET_SITES = ("gossip", "proxy")
+_SITES = _ENGINE_SITES + _NET_SITES
+_ENGINE_MODES = ("raise", "hang", "delay")
+_NET_MODES = ("drop", "delay", "partition")
+_MODES = ("raise", "hang", "delay", "drop", "partition")
+_DEFAULT_SECONDS = {"raise": 0.0, "hang": 30.0, "delay": 0.05,
+                    "drop": 0.0, "partition": 0.0}
 
 
 class InjectedFault(RuntimeError):
     """The error a 'raise' (or an ended 'hang') fault throws — a stand-in
     for whatever a sick device dispatch would have raised."""
+
+
+class InjectedNetworkFault(RuntimeError):
+    """What a 'drop' or 'partition' clause throws at a network site —
+    the cluster layer maps it to ``PeerUnreachable``, so an injected
+    split exercises exactly the real unreachable-peer paths."""
 
 
 @dataclass(frozen=True)
@@ -114,6 +140,11 @@ class FaultPlan:
             if mode not in _MODES:
                 raise ConfigError(
                     f"bad fault mode {mode!r}; one of {_MODES}")
+            allowed = (_NET_MODES if site in _NET_SITES else _ENGINE_MODES)
+            if mode not in allowed:
+                raise ConfigError(
+                    f"fault mode {mode!r} is not valid at site {site!r}; "
+                    f"one of {allowed}")
             lo = hi = prob = None
             try:
                 if sel == "*":
@@ -159,9 +190,11 @@ class FaultInjector:
     def __init__(self, plan: FaultPlan):
         self.plan = plan
         self._lock = threading.Lock()
-        self._counts = {"step": 0, "batched": 0, "any": 0}
+        self._counts = {"step": 0, "batched": 0, "any": 0,
+                        "gossip": 0, "proxy": 0}
         self._rng = random.Random(plan.seed)
-        self.injected = {"raise": 0, "hang": 0, "delay": 0}
+        self.injected = {"raise": 0, "hang": 0, "delay": 0,
+                         "drop": 0, "partition": 0}
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultInjector":
@@ -197,6 +230,49 @@ class FaultInjector:
             # half-commit a step the client was already told timed out
             time.sleep(seconds)
         raise InjectedFault(msg)
+
+    def net_hook(self, site: str, peer: str = "?") -> None:
+        """Called by the cluster layer immediately before an outbound
+        network attempt; ``site`` is 'gossip' or 'proxy'.  Raises
+        :class:`InjectedNetworkFault` (drop/partition) or returns after
+        an optional delay — same counter-under-lock, effect-outside-lock
+        discipline as :meth:`engine_hook`."""
+        action: Optional[Tuple[str, float, str]] = None
+        with self._lock:
+            self._counts[site] += 1
+            nth = self._counts[site]
+            for c in self.plan.clauses:
+                if c.site != site:
+                    continue
+                draw = self._rng.random() if c.prob is not None else None
+                if c.matches(nth, draw):
+                    action = (c.mode, c.seconds,
+                              f"injected {c.mode} at {site} attempt "
+                              f"#{nth} (peer {peer})")
+                    self.injected[c.mode] += 1
+                    break
+        if action is None:
+            return
+        mode, seconds, msg = action
+        if mode == "delay":
+            time.sleep(seconds)
+            return
+        raise InjectedNetworkFault(msg)
+
+    def inbound_cut(self, site: str) -> bool:
+        """True while a ``partition`` clause at ``site`` still covers
+        the NEXT outbound ordinal — inbound refusal tracks the same
+        deterministic window as outbound drops, so the split is
+        symmetric and heals exactly when the clause range is spent.
+        (Probabilistic partition clauses never cut inbound: there is no
+        ordinal to anchor the draw to.)"""
+        with self._lock:
+            nxt = self._counts.get(site, 0) + 1
+            for c in self.plan.clauses:
+                if (c.site == site and c.mode == "partition"
+                        and c.prob is None and c.matches(nxt, None)):
+                    return True
+        return False
 
     def stats(self) -> dict:
         with self._lock:

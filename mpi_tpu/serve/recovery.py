@@ -183,6 +183,40 @@ class StateStore:
         out.sort(key=lambda r: _sid_ordinal(r["id"]))
         return out
 
+    def load_record(self, sid: str) -> Optional[Dict]:
+        """The one parseable record for ``sid``, or None (missing —
+        closed or never checkpointed — or corrupt, which also counts a
+        load error).  The failover adoption path reads exactly one
+        session; scanning the whole dir per adoption would be O(n²)
+        across a dead node's sessions."""
+        path = self._path(sid)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if (not isinstance(rec, dict)
+                    or rec.get("v") != RECORD_VERSION
+                    or rec.get("id") != sid
+                    or not isinstance(rec.get("spec"), dict)
+                    or not isinstance(rec.get("generation"), int)):
+                raise ValueError(f"malformed session record for {sid!r}")
+            return rec
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError, json.JSONDecodeError):
+            with self._lock:
+                self.load_errors += 1
+            return None
+
+    def list_ids(self) -> List[str]:
+        """Session ids with a record on disk — filename-derived, no
+        parsing (failover scans this for the dead node's tag suffix)."""
+        try:
+            names = sorted(os.listdir(self.state_dir))
+        except FileNotFoundError:
+            return []
+        return [name[:-5] for name in names
+                if name.endswith(".json") and name.startswith("s")]
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -322,7 +322,8 @@ class SessionManager:
                  degrade: bool = True,
                  faults=None,
                  obs=None,
-                 tune_cache=None):
+                 tune_cache=None,
+                 defer_restore: bool = False):
         self.obs = obs                  # mpi_tpu.obs.Obs or None (off)
         # autotuned-plan application is OPT-IN: a TuneCache (or a path to
         # one) makes every tpu create consult the cache on compile miss;
@@ -381,7 +382,10 @@ class SessionManager:
         self._last_dispatch_ok: Optional[float] = None
         if self.obs is not None:
             self.obs.bind_manager(self)
-        if self.store is not None:
+        # defer_restore: cluster mode shares --state-dir across nodes, so
+        # boot must NOT slurp every record — attach_cluster restores only
+        # the sessions this node owns under the current ring
+        if self.store is not None and not defer_restore:
             self._restore_all()
 
     # -- lifecycle ---------------------------------------------------------
@@ -394,6 +398,85 @@ class SessionManager:
         self.cluster = node
         if self.dispatcher is not None:
             self.dispatcher.id_suffix = f"@{node.tag}"
+        if self.store is not None:
+            self._restore_owned(node)
+            node.sync_local_sessions()
+
+    def _restore_owned(self, node) -> None:
+        """The cluster half of boot restore (the state dir is shared):
+        restore only the records this node owns — its own tag's sids
+        plus anything the ring or a learned route places here.  Runs
+        before traffic, so placement cannot move mid-restore."""
+        held = set(self.session_ids())
+        for rec in self.store.load_records():
+            sid = rec["id"]
+            if sid in held or node.owner_addr(sid) != node.id:
+                continue
+            try:
+                self._restore_one(rec)
+            except Exception as e:  # noqa: BLE001 — salvage the rest
+                self.restore_errors += 1
+                print(f"note: could not restore session {sid!r}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+        if self.restored_sessions:
+            print(f"[mpi_tpu] restored {self.restored_sessions} session(s) "
+                  f"from {self.store.state_dir}", file=sys.stderr)
+
+    def adopt_session(self, sid: str) -> bool:
+        """Failover/drain adoption: restore one session from the shared
+        state dir via the deterministic replay path.  True when the
+        session is (now) live here; False when there is nothing to adopt
+        (no record — the session was closed, or its checkpoint was lost
+        with the dead node's local disk) or the replay failed."""
+        with self._lock:
+            if sid in self._sessions:
+                return True             # already here (re-delivered adopt)
+        if self.store is None:
+            return False
+        rec = self.store.load_record(sid)
+        if rec is None:
+            return False
+        try:
+            t0 = time.perf_counter()
+            self._restore_one(rec)
+            if self.obs is not None:
+                self.obs.event("session_adopt",
+                               time.perf_counter() - t0, t0, sid=sid,
+                               generation=int(rec["generation"]))
+        except Exception as e:  # noqa: BLE001 — count, report un-adopted
+            self.restore_errors += 1
+            print(f"note: could not adopt session {sid!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return False
+        return True
+
+    def checkpoint_now(self, sid: str) -> None:
+        """Force a full-snapshot checkpoint at the session's CURRENT
+        generation (the drain path: the adopter must replay zero
+        generations).  Raises ``KeyError`` for unknown sids."""
+        session = self.get(sid)
+        if self.store is None:
+            return
+        with session.lock:
+            if session.engine is not None:
+                grid_np = session.engine.fetch(session.grid)
+            else:
+                grid_np = np.asarray(session.grid, dtype=np.uint8)
+            self._persist(session, grid_np)
+
+    def release(self, sid: str) -> None:
+        """Drop a session locally WITHOUT deleting its durable record —
+        the drain handoff: the successor restores from that record, so
+        close()'s delete would lose the session.  Raises ``KeyError``
+        for unknown sids."""
+        with self._lock:
+            session = self._sessions.pop(sid, None)
+        if session is None:
+            raise KeyError(sid)
+        with session.lock:
+            session.closed = True
+            session.grid = None
+            session.engine = None
 
     def session_ids(self) -> list:
         with self._lock:
@@ -1309,6 +1392,11 @@ class SessionManager:
             # folded into "ok": a down peer makes ITS sessions 404, but
             # this process still serves everything it owns
             out["cluster"] = self.cluster.health_block()
+            if self.cluster.draining:
+                # drain flips the PROBE to 503 (the transport keys on
+                # this) while the node keeps serving/proxying — exactly
+                # what a load balancer needs to rotate it out
+                out["draining"] = True
         return out
 
     def __len__(self) -> int:

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import sys
 import time
 import traceback
@@ -406,7 +407,12 @@ class AppCore:
             return self._profile(req)
         if kind == "healthz" and method == "GET":
             health = mgr.health()
-            return json_response(200 if health["ok"] else 503, health)
+            # a draining node still SERVES (clients and proxy hops keep
+            # working) but the probe answers 503 so load balancers
+            # rotate it out; the payload says why
+            code = (200 if health["ok"] and not health.get("draining")
+                    else 503)
+            return json_response(code, health)
         if kind == "stats" and method == "GET":
             return json_response(200, mgr.stats())
         if kind == "sessions" and method == "POST":
@@ -485,11 +491,31 @@ class AppCore:
                           verb: Optional[str], transport: str) -> Response:
         cluster = self.cluster
         if verb == "gossip" and method == "POST":
+            if cluster.inbound_cut("gossip"):
+                # the inbound half of an injected partition: refuse the
+                # digest exactly as a severed link would
+                return json_response(503, {
+                    "error": "gossip partition injected", "ok": False})
             applied = cluster.apply_digest(self._body(req, transport))
             # push-pull: the reply carries OUR digest, so one initiated
             # round synchronizes both directions
             return json_response(200, {"ok": True, "applied": applied,
                                        "digest": cluster.digest()})
+        if verb == "join" and method == "POST":
+            addr = self._body(req, transport).get("node")
+            if not isinstance(addr, str) or not addr.strip():
+                raise ConfigError("join body needs a 'node' address")
+            try:
+                return json_response(200, cluster.handle_join(addr))
+            except ValueError as e:
+                raise ConfigError(f"bad join address {addr!r}: {e}")
+        if verb == "adopt" and method == "POST":
+            sids = self._body(req, transport).get("sids")
+            if not isinstance(sids, list):
+                raise ConfigError("adopt body needs a 'sids' list")
+            return json_response(200, cluster.handle_adopt(sids))
+        if verb == "drain" and method == "POST":
+            return json_response(200, cluster.drain())
         if verb is None and method == "GET":
             return json_response(200, cluster.info())
         return json_response(404, {"error": f"no route {method} {req.path}"})
@@ -507,9 +533,16 @@ class AppCore:
             owner = cluster.owner_addr(new_sid)
             if owner == cluster.id:
                 return None, new_sid
-            return self._proxy_to(owner, req, transport,
+            resp = self._proxy_to(owner, req, transport,
                                   extra={SESSION_ID_HEADER: new_sid},
-                                  missing=("session", new_sid)), None
+                                  missing=("session", new_sid))
+            if resp.code == 200:
+                # the placement decision was made HERE — record it here
+                # too, so the route outlives an owner that dies before
+                # its first gossip round spreads it (failover adoption
+                # scans the survivors' tables for the dead node's sids)
+                cluster.record_route(new_sid, owner)
+            return resp, None
         if kind in ("session", "stream") and sid is not None:
             owner = cluster.owner_addr(sid)
             if owner == cluster.id:
@@ -529,6 +562,13 @@ class AppCore:
             if owner is not None:
                 return self._proxy_to(owner, req, transport,
                                       missing=("ticket", sid)), None
+            dead = cluster.dead_ticket_addr(sid)
+            if dead is not None:
+                # tickets are process-local and died with their owner;
+                # answer the exact structured 404 without a doomed hop
+                # (failover adoption restores sessions, never tickets)
+                return json_response(404, {"error": f"no ticket {sid!r}",
+                                           "peer": dead}), None
         return None, None
 
     def _proxy_to(self, owner: str, req: Request, transport: str,
@@ -562,21 +602,38 @@ class AppCore:
     def _proxy_send(self, owner: str, req: Request, raw: bytes,
                     headers: dict,
                     missing: Optional[Tuple[str, str]]) -> Response:
+        """One proxy hop, hardened: idempotent verbs (GET — snapshots,
+        ticket reads) retry ``--proxy-retries`` times with doubling
+        backoff before giving up; non-idempotent ones fail after the
+        first attempt (a retried step could double-commit).  The final
+        503 carries ``Retry-After`` sized to the gossip interval — by
+        then either the peer answered a heartbeat or failover has begun
+        re-homing its sessions."""
         cluster = self.cluster
-        try:
-            status, ctype, data = proxy_request(
-                owner, req.method, req.path, raw, headers,
-                timeout_s=cluster.timeout_s)
-        except PeerUnreachable as e:
-            what, ident = missing or ("resource", "?")
-            if what == "ticket":
-                # the 404-after-restart ticket contract extended across
-                # the slice: a dead owner's tickets answer the same
-                # structured 404 a restarted single process would
-                return json_response(404, {"error": f"no ticket {ident!r}",
-                                           "peer": owner})
-            return json_response(503, {"error": str(e), "peer": owner})
-        return Response(status, data, ctype)
+        attempts = 1 + (cluster.proxy_retries if req.method == "GET" else 0)
+        err: Optional[PeerUnreachable] = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(cluster.proxy_backoff_s * (2 ** (attempt - 1)))
+            try:
+                cluster.net_fault("proxy", owner)
+                status, ctype, data = proxy_request(
+                    owner, req.method, req.path, raw, headers,
+                    timeout_s=cluster.proxy_timeout_s)
+                return Response(status, data, ctype)
+            except PeerUnreachable as e:
+                err = e
+        what, ident = missing or ("resource", "?")
+        if what == "ticket":
+            # the 404-after-restart ticket contract extended across
+            # the slice: a dead owner's tickets answer the same
+            # structured 404 a restarted single process would
+            return json_response(404, {"error": f"no ticket {ident!r}",
+                                       "peer": owner})
+        resp = json_response(503, {"error": str(err), "peer": owner})
+        resp.headers = [("Retry-After",
+                         str(max(1, math.ceil(cluster.interval_s))))]
+        return resp
 
     # -- distributed trace assembly (GET /debug/trace/<trace_id>) ----------
 

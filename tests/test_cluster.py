@@ -81,11 +81,11 @@ class _Node:
         self.thread.start()
         self.node = None
 
-    def join(self, peers, state_dir=None, down_after_s=None):
+    def join(self, peers, state_dir=None, down_after_s=None, **kw):
         self.node = ClusterNode(self.addr, peers, self.mgr,
                                 interval_s=3600.0,
                                 down_after_s=down_after_s,
-                                state_dir=state_dir, obs=self.obs)
+                                state_dir=state_dir, obs=self.obs, **kw)
         self.mgr.attach_cluster(self.node)
         self.srv.core.cluster = self.node
         return self.node
@@ -497,13 +497,19 @@ def test_live_usage_cluster_totals_equal_sum_of_processes():
     exact sum of the two per-process ledgers."""
     a, b = _pair(with_obs=True)
     try:
+        # allocate until BOTH processes own at least one session (ring
+        # luck can cluster a handful of keys on one side)
         sids = []
-        for i in range(4):
+        i = 0
+        while i < 4 or not (set(a.mgr.session_ids())
+                            and set(b.mgr.session_ids())):
             st, out, _ = _req((a, b)[i % 2].addr, "POST", "/sessions",
                               {"rows": 16, "cols": 16, "backend": "serial",
                                "seed": i})
             assert st == 200
             sids.append(out["id"])
+            i += 1
+            assert i < 40, "ring never placed a session on both nodes"
         for sid in sids:
             st, out, _ = _req(a.addr, "POST", f"/sessions/{sid}/step",
                               {"steps": 3})
@@ -630,6 +636,14 @@ def test_cluster_endpoint_and_metrics():
         text = text.decode() if isinstance(text, bytes) else json.dumps(text)
         assert 'mpi_tpu_cluster_peers{state="alive"} 1' in text
         assert 'mpi_tpu_cluster_gossip_total{direction="sent"}' in text
+        assert "mpi_tpu_cluster_epoch" in text
+        assert ('mpi_tpu_cluster_membership_changes_total'
+                '{kind="confirm_dead"}') in text
+        assert ('mpi_tpu_cluster_failover_sessions_total'
+                '{outcome="adopted"} 0') in text
+        assert ('mpi_tpu_cluster_drain_sessions_total'
+                '{direction="handed_off"} 0') in text
+        assert "mpi_tpu_routing_table_resets_total 0" in text
     finally:
         a.close()
         b.close()
@@ -714,6 +728,23 @@ def test_two_process_group_serves_and_survives_a_kill(tmp_path):
         t2 = None
         for sid in sids:
             st, t, _ = _req(b, "POST", f"/sessions/{sid}/step?async=1",
+                            {"steps": 1})
+            assert st == 200, t
+            st, res, _ = _req(a, "GET", f"/result/{t['ticket']}?wait=1")
+            assert st == 200 and res["status"] == "done", res
+            if t["ticket"].endswith(f"@{node_tag(b)}"):
+                t2 = t["ticket"]
+        # ring luck can place every early sid on process 1; keep
+        # allocating until one ticket provably lands on process 2
+        seed = len(sids)
+        while t2 is None and seed < 40:
+            st, out, _ = _req(b, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            assert st == 200, out
+            seed += 1
+            st, t, _ = _req(b, "POST",
+                            f"/sessions/{out['id']}/step?async=1",
                             {"steps": 1})
             assert st == 200, t
             st, res, _ = _req(a, "GET", f"/result/{t['ticket']}?wait=1")
@@ -831,6 +862,461 @@ def test_two_process_stitched_trace(tmp_path):
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.communicate()
+
+
+# --------------------------------------- self-healing (ISSUE 14)
+
+
+def test_allocating_front_records_route_for_remote_placement():
+    """A create the front places on a PEER must leave a route in the
+    front's OWN table immediately — before any gossip round.  A route
+    known only to its owner dies with the owner; with the allocator
+    also holding it, failover finds the orphan even when the owner is
+    killed between the create and its first heartbeat."""
+    a, b = _pair()
+    try:
+        remote = None
+        for seed in range(40):
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 8, "cols": 8, "backend": "serial",
+                               "seed": seed})
+            assert st == 200
+            sid = out["id"]
+            if sid not in a.mgr.session_ids():
+                remote = sid
+                break
+        assert remote is not None, "ring never placed a session on b"
+        # no gossip_now() anywhere: the route must already be here
+        assert a.node.table.get(remote) == b.addr
+        node, epoch = a.node.table.entry(remote)
+        assert node == b.addr and epoch == a.node.epoch
+        # and on the owner's side too (the serving-side record)
+        assert b.node.table.get(remote) == b.addr
+    finally:
+        a.close()
+        b.close()
+
+
+def test_join_endpoint_admits_new_member_at_bumped_epoch():
+    """A fresh process enters via POST /cluster/join: the admitting
+    node bumps its epoch, the join reply teaches the joiner the whole
+    membership, and gossip spreads the new member — three coherent
+    rings with no process restarted."""
+    a, b = _pair()
+    c = _Node()
+    try:
+        epoch_a = a.node.epoch
+        c.join([a.addr])                # c only seeds from a
+        assert c.node.join_cluster() == 1
+        assert a.node.epoch > epoch_a
+        assert c.addr in a.node.peers
+        assert a.node.members[c.addr][0] == "alive"
+        assert a.node.membership_changes["join"] == 1
+        # the reply digest carried a's map: c knows b without meeting it
+        assert set(c.node.members) >= {a.addr, b.addr, c.addr}
+        # b learns c from a's next gossip round
+        a.node.gossip_now()
+        assert c.addr in b.node.peers
+        for n in (a, b, c):
+            assert sorted(n.node.ring.nodes) == sorted(
+                [a.addr, b.addr, c.addr])
+        keys = [f"s{i}-aaaaaa" for i in range(40)]
+        assert ([a.node.ring.owner(k) for k in keys]
+                == [b.node.ring.owner(k) for k in keys]
+                == [c.node.ring.owner(k) for k in keys])
+        # re-joining a known member is idempotent (re-asserted alive)
+        st, out, _ = _req(a.addr, "POST", "/cluster/join",
+                          {"node": c.addr})
+        assert st == 200 and out["ok"]
+        assert a.node.membership_changes["rejoin"] == 1
+        # a junk address answers a structured 400, never takes a down
+        st, err, _ = _req(a.addr, "POST", "/cluster/join",
+                          {"node": "not-an-address"})
+        assert st == 400 and "error" in err
+    finally:
+        a.close()
+        b.close()
+        c.close()
+
+
+def test_confirmed_death_triggers_bitidentical_adoption(tmp_path):
+    """The tentpole acceptance, in-process and deterministic: a peer
+    goes silent past dead_after_s, the survivor confirms it dead,
+    rebuilds the ring without it, adopts its sessions from the shared
+    --state-dir via deterministic replay, and answers every orphan
+    bit-identically at its exact pre-death generation."""
+    state = str(tmp_path / "shared")
+    a = _Node(state_dir=state)
+    b = _Node(state_dir=state)
+    a.join([b.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    b.join([a.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    try:
+        sids, seeds = [], {}
+        i = 0
+        while i < 4 or not set(b.mgr.session_ids()):
+            front = (a, b)[i % 2]
+            st, out, _ = _req(front.addr, "POST", "/sessions",
+                              {"rows": 20, "cols": 20, "backend": "serial",
+                               "seed": i})
+            assert st == 200, out
+            sids.append(out["id"])
+            seeds[out["id"]] = i
+            i += 1
+            assert i < 40, "ring never placed a session on b"
+        gens = {}
+        for j, sid in enumerate(sids):
+            st, out, _ = _req(a.addr, "POST", f"/sessions/{sid}/step",
+                              {"steps": 2 + j})
+            assert st == 200, out
+            gens[sid] = out["generation"]
+        orphans = sorted(b.mgr.session_ids())
+        a.node.gossip_now()             # fresh heartbeat, then silence
+        b.close()
+        time.sleep(0.15)
+        assert a.node.check_membership() == [b.addr]
+        # membership: tombstoned out of the map and the ring
+        assert a.node.members[b.addr][0] == "dead"
+        assert b.addr not in a.node.peers
+        assert a.node.ring.nodes == [a.addr]
+        assert a.node.membership_changes["confirm_dead"] == 1
+        # failover: every orphan adopted, routed at the death epoch
+        assert a.node.failover_adopted == len(orphans)
+        assert a.node.failover_lost == 0
+        assert set(orphans) <= set(a.mgr.session_ids())
+        for sid in orphans:
+            assert a.node.table.entry(sid) == (a.addr, a.node.epoch)
+        # the dead member stays visible to operators (state: dead)
+        st, h, _ = _req(a.addr, "GET", "/healthz")
+        assert st == 200 and h["ok"]
+        assert h["cluster"]["peers"][b.addr]["state"] == "dead"
+        assert h["cluster"]["epoch"] == a.node.epoch
+        # bit-identity: every session (a's own AND the adopted ones)
+        # answers at its exact generation, equal to the serial oracle
+        for sid in sids:
+            st, snap, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+            assert st == 200, snap
+            assert snap["generation"] == gens[sid]
+            assert np.array_equal(
+                _grid_of(snap), _oracle(20, 20, seeds[sid], gens[sid]))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_dead_peers_tickets_keep_contract_and_are_not_resurrected(tmp_path):
+    """Tickets are process-local by contract: after the owner dies and
+    its sessions fail over, its tickets answer the exact structured 404
+    ({"error", "peer"}) naming the dead address — adoption restores
+    sessions, never tickets."""
+    state = str(tmp_path / "shared")
+    a = _Node(state_dir=state)
+    b = _Node(state_dir=state)
+    a.join([b.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    b.join([a.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    try:
+        # a session held by b, async-stepped there: b's tag on the ticket
+        sid = None
+        seed = 0
+        while sid is None:
+            st, out, _ = _req(b.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            assert st == 200, out
+            seed += 1
+            if out["id"] in b.mgr.session_ids():
+                sid = out["id"]
+        st, t, _ = _req(b.addr, "POST", f"/sessions/{sid}/step?async=1",
+                        {"steps": 2})
+        assert st == 200, t
+        tid = t["ticket"]
+        assert tid.endswith(f"@{b.node.tag}")
+        st, res, _ = _req(b.addr, "GET", f"/result/{tid}?wait=1")
+        assert st == 200 and res["status"] == "done", res
+        a.node.gossip_now()
+        b.close()
+        time.sleep(0.15)
+        assert a.node.check_membership() == [b.addr]
+        assert sid in a.mgr.session_ids()       # the session failed over
+        # ...but its resolved ticket did not: exact 404 contract, no
+        # doomed proxy attempt into the dead address
+        st, err, _ = _req(a.addr, "GET", f"/result/{tid}")
+        assert st == 404
+        assert err == {"error": f"no ticket {tid!r}", "peer": b.addr}
+        # unknown tickets with the dead tag answer the same shape
+        ghost = f"t999@{b.node.tag}"
+        st, err, _ = _req(a.addr, "GET", f"/result/{ghost}")
+        assert st == 404
+        assert err == {"error": f"no ticket {ghost!r}", "peer": b.addr}
+        # the adopted session itself serves at its exact generation
+        st, snap, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+        assert st == 200 and snap["generation"] == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_routing_table_epoch_round_trip_and_v1_upgrade(tmp_path, capsys):
+    path = str(tmp_path / "routing.json")
+    t = RoutingTable(path)
+    t.record("s1-aaaaaa", "h1:8000", epoch=3)
+    t.update({"s2-bbbbbb": ("h2:8000", 5)})
+    # merge rule: a lower epoch loses, an equal epoch is last-writer
+    t.update({"s1-aaaaaa": ("h9:9999", 2)})
+    assert t.entry("s1-aaaaaa") == ("h1:8000", 3)
+    t.update({"s1-aaaaaa": ("h2:8000", 3)})
+    assert t.entry("s1-aaaaaa") == ("h2:8000", 3)
+    # persisted as v2: the round trip keeps nodes AND epochs
+    with open(path) as f:
+        assert json.load(f)["v"] == 2
+    t2 = RoutingTable(path)
+    assert t2.entry("s1-aaaaaa") == ("h2:8000", 3)
+    assert t2.entry("s2-bbbbbb") == ("h2:8000", 5)
+    # a v1 flat table (pre-epoch) loads with every entry at epoch 0...
+    v1 = str(tmp_path / "v1.json")
+    with open(v1, "w") as f:
+        json.dump({"s1-cccccc": "h3:8000"}, f)
+    t3 = RoutingTable(v1)
+    assert t3.entry("s1-cccccc") == ("h3:8000", 0)
+    assert t3.resets == 0
+    # ...so any live announcement supersedes it
+    t3.update({"s1-cccccc": ("h4:8000", 1)})
+    assert t3.entry("s1-cccccc") == ("h4:8000", 1)
+    # corrupt file: counted reset + structured stderr warning, not fatal
+    with open(v1, "w") as f:
+        f.write("{nope")
+    t4 = RoutingTable(v1)
+    assert t4.resets == 1 and len(t4) == 0
+    err = capsys.readouterr().err
+    assert "routing table" in err and "corrupt" in err
+
+
+def test_drain_hands_every_session_off_with_zero_lost_generations(tmp_path):
+    state = str(tmp_path / "shared")
+    a = _Node(state_dir=state)
+    b = _Node(state_dir=state)
+    a.join([b.addr], state_dir=state)
+    b.join([a.addr], state_dir=state)
+    try:
+        sids, seeds = [], {}
+        i = 0
+        while i < 4 or not set(a.mgr.session_ids()):
+            st, out, _ = _req((a, b)[i % 2].addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": i})
+            assert st == 200, out
+            sids.append(out["id"])
+            seeds[out["id"]] = i
+            i += 1
+            assert i < 40, "ring never placed a session on a"
+        gens = {}
+        for j, sid in enumerate(sids):
+            st, out, _ = _req(b.addr, "POST", f"/sessions/{sid}/step",
+                              {"steps": 1 + j})
+            assert st == 200, out
+            gens[sid] = out["generation"]
+        local = sorted(a.mgr.session_ids())
+        epoch0 = a.node.epoch
+        st, out, _ = _req(a.addr, "POST", "/cluster/drain")
+        assert st == 200 and out["ok"], out
+        assert out["handed_off"] == len(local)
+        assert sorted(sum(out["handoffs"].values(), [])) == local
+        assert out["epoch"] > epoch0
+        # the drained node holds nothing; the successor holds everything
+        assert a.mgr.session_ids() == []
+        assert set(local) <= set(b.mgr.session_ids())
+        assert a.node.drain_handed_off == len(local)
+        assert b.node.drain_adopted == len(local)
+        # /healthz flips to 503 draining (the LB signal) but ok stays
+        # true: the node still serves and proxies during handoff
+        st, h, _ = _req(a.addr, "GET", "/healthz")
+        assert st == 503 and h["ok"] and h["draining"]
+        assert h["cluster"]["draining"]
+        # zero lost generations: every session answers bit-identically
+        # at its exact pre-drain generation, through EITHER front
+        for sid in sids:
+            for front in (a.addr, b.addr):
+                st, snap, _ = _req(front, "GET",
+                                   f"/sessions/{sid}/snapshot")
+                assert st == 200, snap
+                assert snap["generation"] == gens[sid]
+                assert np.array_equal(
+                    _grid_of(snap),
+                    _oracle(16, 16, seeds[sid], gens[sid]))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_drain_refuses_when_alone():
+    n = _Node()
+    n.join([])
+    try:
+        st, err, _ = _req(n.addr, "POST", "/cluster/drain")
+        assert st == 400
+        assert "only cluster member" in err["error"]
+    finally:
+        n.close()
+
+
+def test_gossiped_route_naming_this_node_triggers_adoption(tmp_path):
+    """The gossip backup for a lost drain handoff: a route naming THIS
+    node for a session it does not hold makes it adopt from the shared
+    state dir (once — a sid with no record is never re-tried)."""
+    state = str(tmp_path / "shared")
+    a = _Node(state_dir=state)
+    b = _Node(state_dir=state)
+    a.join([b.addr], state_dir=state)
+    b.join([a.addr], state_dir=state)
+    try:
+        sid = None
+        seed = 0
+        while sid is None:
+            st, out, _ = _req(b.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            assert st == 200, out
+            seed += 1
+            if out["id"] in b.mgr.session_ids():
+                sid = out["id"]
+        st, _, _ = _req(b.addr, "POST", f"/sessions/{sid}/step",
+                        {"steps": 3})
+        assert st == 200
+        # hand off out-of-band: checkpoint + release + re-route, as if
+        # the direct /cluster/adopt POST never arrived
+        b.mgr.checkpoint_now(sid)
+        b.mgr.release(sid)
+        b.node.table.update({sid: (a.addr, b.node.epoch + 1)})
+        assert sid not in a.mgr.session_ids()
+        b.node.gossip_now()             # the route rides the digest
+        assert sid in a.mgr.session_ids()
+        assert a.node.drain_adopted == 1
+        # a route for a sid with NO record is negative-cached, not
+        # retried forever
+        b.node.table.update({"s99-ffffff": (a.addr, b.node.epoch + 1)})
+        b.node.gossip_now()
+        assert "s99-ffffff" in a.node._no_adopt
+        st, snap, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+        assert st == 200 and snap["generation"] == 3
+    finally:
+        a.close()
+        b.close()
+
+
+def test_readmit_after_false_death_and_obituary_rejection(tmp_path):
+    """Partition healing: a member confirmed dead that speaks again is
+    re-admitted at a fresh epoch (implicit rejoin), and a tombstone
+    naming a LIVE node is out-versioned by its own re-assertion."""
+    state = str(tmp_path / "shared")
+    a = _Node(state_dir=state)
+    b = _Node(state_dir=state)
+    a.join([b.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.1)
+    b.join([a.addr], state_dir=state)   # b: lazy defaults, never confirms
+    try:
+        a.node.gossip_now()
+        time.sleep(0.12)
+        assert a.node.check_membership() == [b.addr]
+        assert b.addr not in a.node.peers
+        dead_epoch = a.node.epoch
+        # b was alive all along; its next round re-admits it at a
+        # bumped epoch on a's side
+        b.node.gossip_now()
+        assert b.addr in a.node.peers
+        assert a.node.members[b.addr] == ["alive", dead_epoch + 1]
+        assert a.node.membership_changes["rejoin"] == 1
+        assert sorted(a.node.ring.nodes) == sorted([a.addr, b.addr])
+        # a wrong obituary naming the receiver itself: re-asserted
+        # alive at a version that out-bids the tombstone everywhere
+        inject = {"node": a.addr, "seq": 10_000, "inc": a.node._inc,
+                  "epoch": 99, "members": {b.addr: ["dead", 99]},
+                  "sessions": 0, "breakers_open": [], "ledger": None,
+                  "routes": {}}
+        assert b.node.apply_digest(inject)
+        assert b.node.members[b.addr] == ["alive", 100]
+        assert b.node.epoch == 100
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------- chaos harness (network sites)
+
+
+def test_gossip_partition_is_symmetric_and_heals():
+    from mpi_tpu.serve.faults import FaultInjector
+
+    a, b = _pair()
+    try:
+        a.node.gossip_now()
+        sent0, err0 = a.node.gossip_sent, a.node.gossip_errors
+        a.mgr.faults = FaultInjector.from_spec("gossip:1-2:partition")
+        # outbound half: a's sends are severed while the clause covers
+        a.node.gossip_now()
+        assert a.node.gossip_errors == err0 + 1
+        assert a.node.gossip_sent == sent0
+        # inbound half: b's round reaches a's endpoint and is refused
+        assert a.node.inbound_cut("gossip")
+        b_err0 = b.node.gossip_errors
+        b.node.gossip_now()
+        assert b.node.gossip_errors == b_err0 + 1
+        # the clause heals exactly when its range is spent
+        a.node.gossip_now()             # ordinal 2: still severed
+        assert a.node.gossip_errors == err0 + 2
+        assert not a.node.inbound_cut("gossip")
+        a.node.gossip_now()             # ordinal 3: through
+        assert a.node.gossip_sent == sent0 + 1
+        b.node.gossip_now()             # inbound accepted again
+        assert b.node.gossip_errors == b_err0 + 1
+        assert a.mgr.faults.injected["partition"] == 2
+        a.mgr.faults = None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_proxy_get_retries_through_injected_drop_post_fails_fast():
+    from mpi_tpu.serve.faults import FaultInjector
+
+    a, b = _pair()
+    try:
+        # a session owned by b, reached through a: the hop is a's
+        # outbound proxy attempt
+        sid = None
+        seed = 0
+        while sid is None:
+            st, out, _ = _req(a.addr, "POST", "/sessions",
+                              {"rows": 16, "cols": 16, "backend": "serial",
+                               "seed": seed})
+            assert st == 200, out
+            seed += 1
+            if out["id"] not in a.mgr.session_ids():
+                sid = out["id"]
+        # idempotent GET: the first attempt drops, the retry answers
+        a.mgr.faults = FaultInjector.from_spec("proxy:1:drop")
+        st, snap, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+        assert st == 200, snap
+        assert a.mgr.faults.injected["drop"] == 1
+        assert a.mgr.faults.stats()["dispatches"]["proxy"] == 2
+        # non-idempotent POST: ONE attempt, fail fast (the owner may
+        # have applied the step) — 503 with a Retry-After window
+        a.mgr.faults = FaultInjector.from_spec("proxy:1:drop")
+        st, err, hdrs = _req(a.addr, "POST", f"/sessions/{sid}/step",
+                             {"steps": 1})
+        assert st == 503, err
+        assert int(hdrs["Retry-After"]) >= 1
+        assert a.mgr.faults.stats()["dispatches"]["proxy"] == 1
+        # an exhausted GET retry budget surfaces the same 503 contract
+        # after 1 + proxy_retries (default 2) attempts
+        a.mgr.faults = FaultInjector.from_spec("proxy:*:drop")
+        st, err, hdrs = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+        assert st == 503, err
+        assert int(hdrs["Retry-After"]) >= 1
+        assert a.mgr.faults.stats()["dispatches"]["proxy"] == 3
+        a.mgr.faults = None
+        st, _, _ = _req(a.addr, "GET", f"/sessions/{sid}/snapshot")
+        assert st == 200
+    finally:
+        a.close()
+        b.close()
 
 
 def test_cluster_smoke_tool_is_clean():

@@ -109,6 +109,77 @@ def test_injector_delay_mode_proceeds():
     assert inj.stats()["injected"]["delay"] == 1
 
 
+# ------------------------------------------- network sites (ISSUE 14)
+
+
+def test_fault_plan_parses_network_sites_and_modes():
+    p = FaultPlan.parse("gossip:1-8:partition,proxy:1:drop,proxy:2+:delay:0.2")
+    g, d, dl = p.clauses
+    assert (g.site, g.lo, g.hi, g.mode) == ("gossip", 1, 8, "partition")
+    assert (d.site, d.mode, d.seconds) == ("proxy", "drop", 0.0)
+    assert (dl.site, dl.lo, dl.hi, dl.seconds) == ("proxy", 2, None, 0.2)
+
+
+@pytest.mark.parametrize("bad", [
+    "gossip:1:raise",           # engine mode at a network site
+    "proxy:1:hang",
+    "step:1:drop",              # network mode at an engine site
+    "any:1:partition",
+    "network:1:drop",           # unknown site
+])
+def test_fault_plan_rejects_cross_site_modes(bad):
+    with pytest.raises(ConfigError):
+        FaultPlan.parse(bad)
+
+
+def test_net_hook_drops_on_its_ordinal_and_sites_count_alone():
+    from mpi_tpu.serve.faults import InjectedNetworkFault
+
+    inj = FaultInjector.from_spec("gossip:2:drop")
+    inj.net_hook("gossip", "h1:8000")           # 1st: through
+    inj.net_hook("proxy", "h1:8000")            # proxy counts alone
+    with pytest.raises(InjectedNetworkFault):
+        inj.net_hook("gossip", "h1:8000")       # 2nd gossip: severed
+    inj.net_hook("gossip", "h1:8000")           # 3rd: through again
+    stats = inj.stats()
+    assert stats["injected"]["drop"] == 1
+    assert stats["dispatches"]["gossip"] == 3
+    assert stats["dispatches"]["proxy"] == 1
+    # a network fault is its own type, NOT an engine InjectedFault —
+    # the cluster layer maps it to PeerUnreachable
+    assert issubclass(InjectedNetworkFault, RuntimeError)
+    assert not issubclass(InjectedNetworkFault, InjectedFault)
+
+
+def test_net_delay_sleeps_then_proceeds():
+    inj = FaultInjector.from_spec("proxy:1:delay:0.01")
+    t0 = time.perf_counter()
+    inj.net_hook("proxy", "h1:8000")
+    assert time.perf_counter() - t0 >= 0.01
+    assert inj.stats()["injected"]["delay"] == 1
+
+
+def test_inbound_cut_tracks_the_partition_window():
+    from mpi_tpu.serve.faults import InjectedNetworkFault
+
+    inj = FaultInjector.from_spec("gossip:2-3:partition")
+    assert not inj.inbound_cut("gossip")        # next ordinal 1: clear
+    inj.net_hook("gossip")                      # ordinal 1: through
+    assert inj.inbound_cut("gossip")            # ordinals 2-3 covered
+    assert not inj.inbound_cut("proxy")         # other site never cut
+    with pytest.raises(InjectedNetworkFault):
+        inj.net_hook("gossip")                  # ordinal 2: severed
+    assert inj.inbound_cut("gossip")
+    with pytest.raises(InjectedNetworkFault):
+        inj.net_hook("gossip")                  # ordinal 3: range spent
+    assert not inj.inbound_cut("gossip")        # healed, symmetric
+    inj.net_hook("gossip")                      # ordinal 4: through
+    assert inj.stats()["injected"]["partition"] == 2
+    # probabilistic partitions never cut inbound (no ordinal anchor)
+    pinj = FaultInjector.from_spec("gossip:p1.0:partition")
+    assert not pinj.inbound_cut("gossip")
+
+
 # ------------------------------------------------------ retry + breaker
 
 

@@ -24,7 +24,18 @@ order:
    tickets, ``GET /debug/trace`` for the stage-1 trace answers 200
    with the dead peer named in ``partial`` (no hang, no 500), and its
    ``/healthz`` flips the peer to down, while ``ok`` stays true and
-   locally-owned sessions keep serving.
+   locally-owned sessions keep serving;
+5. **chaos** (ISSUE 14) — a fresh THREE-process group over a shared
+   ``--state-dir`` with tight suspect/confirm thresholds.  One node
+   boots under ``--inject-faults 'gossip:1-4:partition'``: the seeded
+   two-way split provably engages (gossip errors on the cut node) and
+   heals on its own once the clause range is spent — all three nodes
+   mutually alive again with no process restarted.  Then one
+   session-owning node is SIGKILLed: the survivors confirm it dead
+   within the heartbeat thresholds, adopt its sessions from the shared
+   state dir by deterministic replay, and answer every orphan
+   **byte-identically** to its pre-kill snapshot (requests inside the
+   failover window may answer 503, which must carry ``Retry-After``).
 
 Exit-code contract (shared with the other ``tools/ci_gate.sh`` stages):
 0 clean, 1 findings, 2 internal error.  Needs jax only inside the
@@ -39,18 +50,23 @@ import os
 import re
 import subprocess
 import sys
+import tempfile
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 from mpi_tpu.cluster import node_tag                      # noqa: E402
+from mpi_tpu.cluster.proxy import FORWARDED_HEADER        # noqa: E402
 from mpi_tpu.utils.net import (                           # noqa: E402
     PORT_RETRIES, bind_collision, free_port,
 )
 
 FAULTS = "step:1:raise"
 GOSSIP_S = 0.25
+CHAOS_FAULTS = "gossip:1-4:partition"
+CHAOS_DOWN_S = 1.0
+CHAOS_DEAD_S = 2.5
 TRACEPARENT = re.compile(r"^00-([0-9a-f]{32})-[0-9a-f]{16}-01$")
 
 
@@ -59,10 +75,10 @@ def _req(addr, method, path, body=None):
     return st, out
 
 
-def _req_h(addr, method, path, body=None):
+def _req_h(addr, method, path, body=None, headers=None):
     conn = http.client.HTTPConnection(addr, timeout=30)
     payload = json.dumps(body).encode() if body is not None else None
-    conn.request(method, path, body=payload)
+    conn.request(method, path, body=payload, headers=headers or {})
     resp = conn.getresponse()
     data = resp.read()
     hdrs = dict(resp.getheaders())
@@ -88,6 +104,26 @@ def _spawn(port, peer_port):
          "--no-batch"],
         env=env, cwd=ROOT, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True)
+
+
+def _spawn_chaos(port, peer_ports, state_dir, faults=None):
+    env = dict(os.environ)
+    env["MPI_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT
+    cmd = [sys.executable, "-m", "mpi_tpu.cli", "serve",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--peers", ",".join(f"127.0.0.1:{p}" for p in peer_ports),
+           "--gossip-interval-s", str(GOSSIP_S),
+           "--peer-down-s", str(CHAOS_DOWN_S),
+           "--peer-dead-s", str(CHAOS_DEAD_S),
+           "--state-dir", state_dir,
+           "--no-batch"]
+    if faults:
+        cmd += ["--inject-faults", faults]
+    return subprocess.Popen(cmd, env=env, cwd=ROOT,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
 
 
 def _wait_healthy(addr, deadline_s=90.0):
@@ -349,6 +385,152 @@ def main() -> int:
             served += st == 200
         check(served > 0, f"survivor still serves its own sessions "
                           f"({served}/{len(sids)} reachable)")
+
+        # -- 5: chaos — seeded partition heals, SIGKILL fails over -------
+        print("stage 5: chaos (partition heal + SIGKILL failover)")
+        state_dir = tempfile.mkdtemp(prefix="gol-chaos-")
+        for attempt in range(PORT_RETRIES):
+            q1, q2, q3 = free_port(), free_port(), free_port()
+            ports = (q1, q2, q3)
+            chaos = [_spawn_chaos(q1, (q2, q3), state_dir,
+                                  faults=CHAOS_FAULTS),
+                     _spawn_chaos(q2, (q1, q3), state_dir),
+                     _spawn_chaos(q3, (q1, q2), state_dir)]
+            procs.extend(chaos)
+            time.sleep(0.5)
+            died = [p for p in chaos if p.poll() is not None]
+            if not died:
+                break
+            errs = "".join(p.communicate()[1] for p in died)
+            for p in chaos:
+                p.kill()
+                p.communicate()
+                procs.remove(p)
+            if bind_collision(errs) and attempt + 1 < PORT_RETRIES:
+                continue
+            print(f"cluster_smoke: chaos process died at boot:\n"
+                  f"{errs[-2000:]}", file=sys.stderr)
+            return 2
+        nodes = [f"127.0.0.1:{p}" for p in ports]
+        if not all(_wait_healthy(n) for n in nodes):
+            print("cluster_smoke: chaos group never became healthy",
+                  file=sys.stderr)
+            return 2
+        print(f"  chaos group up ({', '.join(nodes)}, "
+              f"faults={CHAOS_FAULTS!r} on {nodes[0]})")
+
+        # the partition clause spans the cut node's first four gossip
+        # sends: provably engaged once four injected errors show, then
+        # spent — the group must converge back to mutual aliveness with
+        # no process restarted
+        def _fault_engaged():
+            st, info = _req(nodes[0], "GET", "/cluster")
+            return st == 200 and info["gossip"]["errors"] >= 4
+        check(bool(_poll(20.0, _fault_engaged)),
+              "the seeded gossip partition engaged (>= 4 injected send "
+              "errors on the cut node)")
+
+        def _healed():
+            for n in nodes:
+                st, h = _req(n, "GET", "/healthz")
+                if st != 200 or len(h["cluster"]["peers"]) < 2:
+                    return False
+                if any(p["state"] != "alive"
+                       for p in h["cluster"]["peers"].values()):
+                    return False
+            return True
+        check(bool(_poll(30.0, _healed)),
+              "the partition healed once the fault clause expired "
+              "(all three mutually alive, no restart)")
+
+        sids5, pre = [], {}
+        for i in range(6):
+            front = nodes[i % 3]
+            st, out = _req(front, "POST", "/sessions",
+                           {"rows": 12, "cols": 12, "backend": "serial",
+                            "seed": 140 + i})
+            if not check(st == 200, f"chaos create via {front} -> {st}"):
+                return 1
+            sids5.append(out["id"])
+        for sid in sids5:
+            st, out = _req(nodes[0], "POST", f"/sessions/{sid}/step",
+                           {"steps": 2})
+            check(st == 200 and out.get("generation") == 2,
+                  f"chaos step {sid} -> generation 2")
+            st, snap = _req(nodes[1], "GET", f"/sessions/{sid}/snapshot")
+            check(st == 200, f"pre-kill snapshot of {sid}")
+            pre[sid] = snap
+
+        # which process actually HOLDS each session: the forwarded
+        # header pins serving to the receiving node, so a 200 means
+        # "held here" and a 404 "held elsewhere" — no routing guesswork
+        held = {}
+        for n in nodes:
+            mine = []
+            for sid in sids5:
+                st, _, _ = _req_h(n, "GET",
+                                  f"/sessions/{sid}/snapshot",
+                                  headers={FORWARDED_HEADER: "probe"})
+                if st == 200:
+                    mine.append(sid)
+            held[n] = mine
+        victim = next((n for n in nodes if held[n]), None)
+        if not check(victim is not None,
+                     "at least one chaos node holds a session"):
+            return 1
+        orphans = held[victim]
+        survivors = [n for n in nodes if n != victim]
+        vproc = chaos[nodes.index(victim)]
+        print(f"  victim {victim} holds {len(orphans)} session(s)")
+        vproc.kill()
+        vproc.communicate()
+
+        # a request inside the failover window may answer 503 — which
+        # must then carry a usable Retry-After; a 200 here just means
+        # the window was already over (both are correct)
+        st, _, hdrs = _req_h(survivors[0], "GET",
+                             f"/sessions/{orphans[0]}/snapshot")
+        if st == 503:
+            ra = hdrs.get("Retry-After", "")
+            check(ra.isdigit() and int(ra) >= 1,
+                  f"failover-window 503 carries Retry-After ({ra!r})")
+
+        def _victim_dead():
+            for n in survivors:
+                st, h = _req(n, "GET", "/healthz")
+                if st != 200 or not h["ok"]:
+                    return False
+                peer = h["cluster"]["peers"].get(victim, {})
+                if peer.get("state") != "dead":
+                    return False
+            return True
+        check(bool(_poll(30.0, _victim_dead)),
+              "both survivors confirmed the victim dead within the "
+              "heartbeat thresholds")
+
+        def _adopted_bitident():
+            for sid in orphans:
+                st, snap = _req(survivors[0], "GET",
+                                f"/sessions/{sid}/snapshot")
+                if st != 200 or snap != pre[sid]:
+                    return False
+            return True
+        check(bool(_poll(30.0, _adopted_bitident)),
+              f"all {len(orphans)} orphaned session(s) adopted from "
+              f"the shared state dir and served bit-identically to "
+              f"their pre-kill snapshots")
+
+        def _adoptions_counted():
+            total = 0
+            for n in survivors:
+                st, info = _req(n, "GET", "/cluster")
+                if st != 200:
+                    return False
+                total += info["failover"]["adopted"]
+            return total == len(orphans)
+        check(bool(_poll(10.0, _adoptions_counted)),
+              f"survivors' failover.adopted counters total exactly "
+              f"{len(orphans)} (each orphan adopted once, none twice)")
 
     except Exception as e:                                # noqa: BLE001
         print(f"cluster_smoke: internal error: {type(e).__name__}: {e}",

@@ -41,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import urllib.request
 
-from mpi_tpu.analysis.obsreg import required_families
+from mpi_tpu.analysis.obsreg import cluster_families, required_families
 
 # the metric families every scrape must expose (pre-registered or bound
 # at manager attach — present even before traffic touches a site), and
@@ -52,8 +52,10 @@ from mpi_tpu.analysis.obsreg import required_families
 # forget.
 REQUIRED_METRICS, AIO_METRICS = required_families()
 # families registered only in cluster mode (mpi_tpu/cluster/, PR 12) —
-# required ABSENT from a single-process scrape, which this smoke drives
-CLUSTER_METRICS = ("mpi_tpu_cluster_peers", "mpi_tpu_cluster_gossip_total")
+# required ABSENT from a single-process scrape, which this smoke drives.
+# Extracted, not hand-listed: a new cluster family is pinned absent here
+# the moment it is registered (the same no-drift rule as the core set)
+CLUSTER_METRICS = tuple(cluster_families())
 # the per-process identity labels cluster mode stamps on every sample
 INSTANCE_LABELS = ("host", "process")
 # span kinds the async path must leave in the trace (PR 5)

@@ -53,6 +53,11 @@ KNOWN_DYNAMIC_SPANS = {"phase:setup", "phase:steady"}
 # scanned tree actually ships tracectx (fixture corpora predate it)
 TRACE_CONTEXT_COLUMNS = ("trace_id", "span_id", "parent_span_id")
 
+# families registered only when --telemetry-interval-s arms the sampler
+# (ISSUE 15): present on an ARMED scrape, absent otherwise — like the
+# cluster families, they belong to neither required list
+SLO_MODULES = ("mpi_tpu/obs/slo.py", "mpi_tpu/obs/timeseries.py")
+
 _BACKTICK = re.compile(r"`([^`]+)`")
 _FAMILY_TOKEN = re.compile(r"^mpi_tpu_[a-z0-9_{},*]+$")
 _FAMILY_LIT = re.compile(r"^mpi_tpu_[a-z0-9_]*[a-z0-9]$")
@@ -147,11 +152,14 @@ def required_families(registry: Optional[dict] = None) -> Tuple[List[str],
     else must be present on any instrumented scrape.  Families
     registered by ``mpi_tpu/cluster/`` exist only when serving with
     ``--peers`` and belong to neither list (see
-    :func:`cluster_families`)."""
+    :func:`cluster_families`); likewise the ``SLO_MODULES`` families
+    exist only when ``--telemetry-interval-s`` arms the sampler (see
+    :func:`slo_families`)."""
     registry = registry or extract_registry()
     core, aio = [], []
     for name, info in sorted(registry["metrics"].items()):
-        if info["module"].startswith("mpi_tpu/cluster/"):
+        if info["module"].startswith("mpi_tpu/cluster/") \
+                or info["module"] in SLO_MODULES:
             continue
         (aio if info["module"] == "mpi_tpu/serve/aio.py" else core).append(name)
     return core, aio
@@ -164,6 +172,16 @@ def cluster_families(registry: Optional[dict] = None) -> List[str]:
     registry = registry or extract_registry()
     return sorted(name for name, info in registry["metrics"].items()
                   if info["module"].startswith("mpi_tpu/cluster/"))
+
+
+def slo_families(registry: Optional[dict] = None) -> List[str]:
+    """Families registered by the telemetry/SLO modules — present on a
+    scrape only when ``--telemetry-interval-s`` (or ``--slo-file``) arms
+    the sampler.  The runtime smoke pins them ABSENT on an unarmed
+    scrape (the default-off purity gate) and present on an armed one."""
+    registry = registry or extract_registry()
+    return sorted(name for name, info in registry["metrics"].items()
+                  if info["module"] in SLO_MODULES)
 
 
 # -- README cross-check ---------------------------------------------------

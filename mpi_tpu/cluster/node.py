@@ -75,7 +75,8 @@ class _PeerState:
     node lock)."""
 
     __slots__ = ("addr", "tag", "last_seen", "last_seq", "sessions",
-                 "ledger", "breakers_open", "added_at", "inc", "suspect")
+                 "ledger", "slo", "breakers_open", "added_at", "inc",
+                 "suspect")
 
     def __init__(self, addr: str):
         self.addr = addr
@@ -84,6 +85,7 @@ class _PeerState:
         self.last_seq = 0
         self.sessions = 0
         self.ledger: Optional[dict] = None          # latest totals() snapshot
+        self.slo: Optional[dict] = None             # latest compact SLO state
         self.breakers_open: List[str] = []
         self.added_at = time.monotonic()            # suspect clock baseline
         self.inc: Optional[float] = None            # sender incarnation
@@ -529,6 +531,11 @@ class ClusterNode:
             "breakers_open": mgr.cache.breaker_stats()["open"],
             "ledger": (mgr.obs.ledger.totals()
                        if mgr.obs is not None else None),
+            # armed-only (ISSUE 15): unarmed nodes gossip None and the
+            # /slo roll-up counts them as not reporting
+            "slo": (mgr.obs.slo.compact()
+                    if mgr.obs is not None and mgr.obs.slo is not None
+                    else None),
             "routes": self.table.snapshot_entries(),
         }
 
@@ -572,6 +579,8 @@ class ClusterNode:
             ps.sessions = int(digest.get("sessions") or 0)
             ledger = digest.get("ledger")
             ps.ledger = ledger if isinstance(ledger, dict) else None
+            slo = digest.get("slo")
+            ps.slo = slo if isinstance(slo, dict) else None
             ps.breakers_open = [str(b) for b in
                                 (digest.get("breakers_open") or [])]
             breakers = list(ps.breakers_open)
@@ -744,6 +753,49 @@ class ClusterNode:
             "nodes": len(by_node),
             "nodes_reporting": len(reporting),
             "totals": merge_totals(reporting),
+            "by_node": by_node,
+        }
+
+    def slo_rollup(self) -> dict:
+        """The ``cluster`` block on ``GET /slo``: the local compact SLO
+        state plus each peer's latest gossiped one.  Transition counts
+        are CUMULATIVE per node, so summing the snapshots is exact as of
+        each peer's last digest (the ledger roll-up discipline); a peer
+        whose heartbeat says it is down lands in ``partial`` like the
+        trace fan-out's — its stale snapshot stays visible in
+        ``by_node`` but the roll-up admits it is incomplete."""
+        _rank = {"ok": 0, "warning": 1, "critical": 2}
+        mgr = self.manager
+        local = (mgr.obs.slo.compact()
+                 if mgr.obs is not None and mgr.obs.slo is not None
+                 else None)
+        by_node: Dict[str, Optional[dict]] = {self.id: local}
+        with self._lock:
+            for addr, ps in self.peers.items():
+                by_node[addr] = ps.slo
+        partial = sorted(addr for addr, st in
+                         self.health_block()["peers"].items()
+                         if not st["alive"])
+        reporting = [s for s in by_node.values() if s]
+        states = [s.get("worst") for s in reporting]
+        states = [s for s in states if s in _rank]
+        burning: Dict[str, str] = {}
+        for snap in reporting:
+            for name, state in (snap.get("states") or {}).items():
+                if state in _rank and state != "ok" and \
+                        _rank[state] > _rank.get(burning.get(name), -1):
+                    burning[name] = state
+        return {
+            "node": self.id,
+            "nodes": len(by_node),
+            "nodes_reporting": len(reporting),
+            "partial": partial,
+            "complete": not partial,
+            "worst": (max(states, key=_rank.__getitem__)
+                      if states else "ok"),
+            "burning": burning,
+            "transitions_total": sum(
+                int(s.get("transitions") or 0) for s in reporting),
             "by_node": by_node,
         }
 

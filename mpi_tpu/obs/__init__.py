@@ -111,6 +111,10 @@ class Obs:
         # step_calls/batched_step_calls are scraped at render time
         # (mpi_tpu_engine_counters_total), so the hot path pays nothing
         # for them.
+        # telemetry history + SLO engine (ISSUE 15): None until
+        # arm_telemetry() — the unarmed scrape/trace stay byte-identical
+        self.telemetry = None
+        self.slo = None
         self.dispatch_solo = self.dispatch_latency.series(mode="solo")
         self.dispatch_batched = self.dispatch_latency.series(mode="batched")
         self.dispatch_host = self.dispatch_latency.series(mode="host")
@@ -142,6 +146,34 @@ class Obs:
         def sink(phase: str, t0: float, dur_s: float) -> None:
             self.tracer.event(f"phase:{phase}", dur_s, t0)
         return sink
+
+    # -- telemetry history + SLO engine (ISSUE 15) -----------------------
+
+    def arm_telemetry(self, interval_s: float = 5.0, manager=None,
+                      objectives=None, damp_evals: int = 3,
+                      clock=None, start: bool = True):
+        """Construct the sampler + SLO engine behind
+        ``--telemetry-interval-s``.  Idempotent; ``start=False`` (tests)
+        skips the daemon thread so ``sample_once``/``evaluate`` can be
+        driven by hand against an injected ``clock``."""
+        if self.telemetry is not None:
+            return self.telemetry
+        from mpi_tpu.obs.slo import SloEngine, default_objectives
+        from mpi_tpu.obs.timeseries import TelemetryRecorder
+
+        kw = {} if clock is None else {"clock": clock}
+        tel = TelemetryRecorder(self.metrics, interval_s=interval_s, **kw)
+        slo = SloEngine(objectives or default_objectives(), tel,
+                        manager=manager, obs=self,
+                        damp_evals=damp_evals, **kw)
+        tel.after_sample = slo.evaluate
+        tel.bind_metrics(self.metrics)
+        slo.bind_metrics(self.metrics)
+        self.telemetry = tel
+        self.slo = slo
+        if start:
+            tel.start()
+        return tel
 
     # -- manager binding -------------------------------------------------
 
@@ -363,7 +395,12 @@ class Obs:
         return self.metrics.render(openmetrics=openmetrics)
 
     def stats(self) -> dict:
-        return {"trace": self.tracer.stats()}
+        out = {"trace": self.tracer.stats()}
+        if self.telemetry is not None:
+            out["telemetry"] = self.telemetry.stats()
+        return out
 
     def close(self) -> None:
+        if self.telemetry is not None:
+            self.telemetry.stop()
         self.tracer.close()

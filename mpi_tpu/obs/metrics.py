@@ -122,6 +122,14 @@ class Counter(_Metric):
         with self._lock:
             return self._vals.get(_key(labels), 0.0)
 
+    def total(self, where: Optional[Callable[[dict], bool]] = None) -> float:
+        """Sum across label sets, optionally filtered by a predicate over
+        the labels dict — the telemetry sampler's counter readout."""
+        with self._lock:
+            if where is None:
+                return sum(self._vals.values())
+            return sum(v for k, v in self._vals.items() if where(dict(k)))
+
     def render(self) -> List[str]:
         out = self._header()
         with self._lock:
@@ -150,6 +158,21 @@ class _FnMetric(_Metric):
         super().__init__(name, help_, lock)
         self._fn = fn
         self.kind = kind
+
+    def read_sum(self) -> Optional[float]:
+        """Evaluate the callback now and collapse it to one number (label
+        sets summed); ``None`` when the provider raises — same tolerance
+        as ``render``.  Used by the telemetry sampler, never by scrapes."""
+        try:
+            val = self._fn()
+        except Exception:  # noqa: BLE001 — same contract as render()
+            return None
+        if isinstance(val, (int, float)):
+            return float(val)
+        try:
+            return float(sum(v for _, v in val))
+        except Exception:  # noqa: BLE001
+            return None
 
     def render(self) -> List[str]:
         try:
@@ -239,6 +262,17 @@ class Histogram(_Metric):
         with self._lock:
             st = self._series.get(_key(labels))
             return st[2] if st else 0
+
+    def total_count(self) -> int:
+        """Observations across every label set — the sampler's "how many
+        dispatches happened" readout."""
+        with self._lock:
+            return sum(st[2] for st in self._series.values())
+
+    def total_sum(self) -> float:
+        """Summed observed values across every label set."""
+        with self._lock:
+            return float(sum(st[1] for st in self._series.values()))
 
     def render(self, exemplars: bool = False) -> List[str]:
         out = self._header()

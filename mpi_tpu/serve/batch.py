@@ -295,6 +295,9 @@ class MicroBatcher:
                 obs.dispatch_batched_tuned.observe(t2 - t1)
             else:
                 obs.dispatch_batched.observe(t2 - t1)
+            tel = obs.telemetry
+            if tel is not None:
+                tel.dispatch_digest.observe(t2 - t1)
             # usage ledger: ONE sync split evenly across the B riders
             # (shares sum to the leader's block time); the failed-batch
             # path above commits nothing here — each solo fallback

@@ -111,6 +111,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "(the ring buffer alone otherwise; dumped on any 500)")
     p.add_argument("--trace-capacity", type=int, default=4096,
                    help="span ring-buffer size (oldest spans overwritten)")
+    p.add_argument("--telemetry-interval-s", type=float, default=None,
+                   metavar="SECS",
+                   help="arm the in-process telemetry recorder + SLO "
+                   "engine: sample selected metrics series and evaluate "
+                   "burn rates every SECS seconds, serving GET /slo and "
+                   "GET /debug/timeseries.  Unset (the default): no "
+                   "sampler runs and the scrape/trace output is "
+                   "byte-identical to pre-telemetry builds")
+    p.add_argument("--slo-file", default=None, metavar="PATH",
+                   help="JSON objectives for the SLO engine (see README "
+                   "'SLOs and telemetry history' for the schema); implies "
+                   "--telemetry-interval-s 5 when that flag is unset.  "
+                   "Unset: built-in defaults (availability 99.9%%, "
+                   "dispatch p99 < 1s, freshness 600s)")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="arm POST /debug/profile?secs=N: captures a "
                    "jax.profiler device trace into DIR (off when unset)")
@@ -219,6 +233,27 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    telemetry_s = args.telemetry_interval_s
+    if telemetry_s is None and args.slo_file:
+        telemetry_s = 5.0               # --slo-file implies arming
+    if telemetry_s is not None and obs is None:
+        print("error: --telemetry-interval-s/--slo-file need "
+              "observability (drop --no-obs)", file=sys.stderr)
+        return 2
+    if telemetry_s is not None:
+        from mpi_tpu.obs.slo import load_slo_file
+
+        objectives = None
+        if args.slo_file:
+            try:
+                objectives, slo_opts = load_slo_file(args.slo_file)
+            except ConfigError as e:
+                print(f"error: --slo-file: {e}", file=sys.stderr)
+                return 2
+        else:
+            slo_opts = {}
+        obs.arm_telemetry(interval_s=telemetry_s, manager=manager,
+                          objectives=objectives, **slo_opts)
     if args.front == "aio":
         from mpi_tpu.serve.aio import make_aio_server
 
@@ -293,6 +328,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         extras.append("obs off")
     elif args.trace_log:
         extras.append(f"trace-log {args.trace_log}")
+    if telemetry_s is not None:
+        extras.append(f"telemetry {telemetry_s}s"
+                      + (f" slo-file {args.slo_file}"
+                         if args.slo_file else ""))
     if args.profile_dir:
         extras.append(f"profile-dir {args.profile_dir}")
     if args.front != "threaded":

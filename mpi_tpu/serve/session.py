@@ -856,6 +856,13 @@ class SessionManager:
     def _mark_dispatch_ok(self) -> None:
         self._last_dispatch_ok = time.monotonic()
 
+    def last_dispatch_age_s(self) -> Optional[float]:
+        """Seconds since the last committed dispatch, None before the
+        first — the freshness SLO's input (and /healthz's age field)."""
+        if self._last_dispatch_ok is None:
+            return None
+        return time.monotonic() - self._last_dispatch_ok
+
     # -- verbs -------------------------------------------------------------
 
     def step(self, sid: str, steps: int = 1,
@@ -998,6 +1005,9 @@ class SessionManager:
                     obs.dispatch_solo_tuned.observe(t2 - t1)
                 else:
                     obs.dispatch_solo.observe(t2 - t1)
+                tel = obs.telemetry
+                if tel is not None:
+                    tel.dispatch_digest.observe(t2 - t1)
                 # usage ledger: one committed sync.  The unit path is an
                 # async solo chain (ONE block for `steps` depth-1
                 # executions); its FLOPs are the depth-1 card times the
@@ -1031,6 +1041,9 @@ class SessionManager:
                 obs.event("host_step", t1 - t0, t0,
                           sid=session.id, steps=steps)
                 obs.dispatch_host.observe(t1 - t0)
+                tel = obs.telemetry
+                if tel is not None:
+                    tel.dispatch_digest.observe(t1 - t0)
                 # host wall is metered apart from device-seconds (the
                 # ledger's host_s bucket); degraded tpu sessions keep
                 # their signature row, plain host backends get "-"
@@ -1089,9 +1102,13 @@ class SessionManager:
             if ticket.status == "pending":
                 ticket.event.wait(self._budget(timeout_s))
             if self.obs is not None:
-                self.obs.event("ticket_wait", time.perf_counter() - t0, t0,
+                dt = time.perf_counter() - t0
+                self.obs.event("ticket_wait", dt, t0,
                                ticket=tid, sid=ticket.sid,
                                resolved=ticket.status != "pending")
+                tel = self.obs.telemetry
+                if tel is not None:
+                    tel.ticket_wait_digest.observe(dt)
         if ticket.status == "error":
             raise ticket.error
         out = {"ticket": ticket.id, "id": ticket.sid,
@@ -1361,6 +1378,21 @@ class SessionManager:
             out["cluster"] = self.cluster.usage_rollup()
         return out
 
+    def slo(self) -> dict:
+        """The ``GET /slo`` payload: the engine's full snapshot (states,
+        burn rates, window summaries) plus the cluster roll-up when a
+        node is attached.  The transport answers 404 before calling this
+        when obs is off or telemetry is unarmed."""
+        if self.obs is None or self.obs.slo is None:
+            raise RuntimeError(
+                "SLO evaluation needs --telemetry-interval-s")
+        out = self.obs.slo.snapshot()
+        if self.cluster is not None:
+            # slice-wide roll-up: local compact state + each peer's
+            # latest gossiped snapshot (same discipline as /usage)
+            out["cluster"] = self.cluster.slo_rollup()
+        return out
+
     def health(self) -> dict:
         """The deep ``/healthz`` payload.  ``ok`` is False — the probe
         answers 503 — exactly when the service is degraded with no
@@ -1370,8 +1402,8 @@ class SessionManager:
             sessions = list(self._sessions.values())
         br = self.cache.breaker_stats()
         ok = not (br["open"] and not self.degrade)
-        age = (round(time.monotonic() - self._last_dispatch_ok, 3)
-               if self._last_dispatch_ok is not None else None)
+        age = self.last_dispatch_age_s()
+        age = round(age, 3) if age is not None else None
         out = {
             "ok": ok,
             "sessions": len(sessions),
@@ -1387,6 +1419,12 @@ class SessionManager:
             "faults_injected": (sum(self.faults.injected.values())
                                 if self.faults is not None else 0),
         }
+        if self.obs is not None and self.obs.slo is not None:
+            # alerting, not readiness: a burning SLO (even critical
+            # availability) never flips "ok" — the probe keys readiness
+            # on degraded-without-fallback, and restarting a process
+            # because its error budget is gone only burns it faster
+            out["slo"] = self.obs.slo.health_block()
         if self.cluster is not None:
             # peer liveness from gossip heartbeats.  Deliberately not
             # folded into "ok": a down peer makes ITS sessions 404, but

@@ -179,12 +179,16 @@ class AppCore:
                 req.headers.get(TRACEPARENT_HEADER)) or mint()
             token = set_request_id(rid)
             ttoken = set_trace_context(tctx)
+            t0 = time.perf_counter()
             try:
                 with obs.span("http_request", method=req.method,
                               path=req.path) as sp:
                     resp = self._guard(req, rid, obs, transport)
                     sp.tag(code=resp.code)
                 obs.http_requests.inc(method=req.method, code=resp.code)
+                tel = obs.telemetry
+                if tel is not None:
+                    tel.http_digest.observe(time.perf_counter() - t0)
             finally:
                 reset_trace_context(ttoken)
                 reset_request_id(token)
@@ -274,8 +278,12 @@ class AppCore:
             return "metrics", None, None
         if parts == ["usage"]:
             return "usage", None, None
+        if parts == ["slo"]:
+            return "slo", None, None
         if parts == ["debug", "profile"]:
             return "profile", None, None
+        if parts == ["debug", "timeseries"]:
+            return "timeseries", None, None
         if len(parts) == 3 and parts[:2] == ["debug", "trace"]:
             return "trace", parts[2], None      # parts[2] is the trace id
         if parts and parts[0] == "cluster":
@@ -403,6 +411,22 @@ class AppCore:
                 return json_response(404, {
                     "error": "observability is disabled (--no-obs)"})
             return json_response(200, mgr.usage())
+        if kind in ("slo", "timeseries") and method == "GET":
+            # armed-only surfaces (ISSUE 15): --no-obs answers the usual
+            # structured 404, and an instrumented-but-unarmed server
+            # answers a 404 naming the flag — the endpoints exist only
+            # when the sampler exists, mirroring the scrape's armed-only
+            # slo families
+            if obs is None:
+                return json_response(404, {
+                    "error": "observability is disabled (--no-obs)"})
+            if obs.telemetry is None:
+                return json_response(404, {
+                    "error": "telemetry is not armed "
+                             "(--telemetry-interval-s)"})
+            if kind == "slo":
+                return json_response(200, mgr.slo())
+            return self._timeseries(req, obs.telemetry)
         if kind == "profile" and method == "POST":
             return self._profile(req)
         if kind == "healthz" and method == "GET":
@@ -634,6 +658,41 @@ class AppCore:
         resp.headers = [("Retry-After",
                          str(max(1, math.ceil(cluster.interval_s))))]
         return resp
+
+    # -- telemetry history (GET /debug/timeseries) -------------------------
+
+    def _timeseries(self, req: Request, tel) -> Response:
+        """``?series=&window=`` over the recorder's rings: no ``series``
+        lists what is recorded; with one, counters render as rates and
+        gauges raw, timestamps monotone non-decreasing by construction
+        (samples append in clock order)."""
+        from mpi_tpu.obs.timeseries import WINDOW_S
+
+        qs = parse_qs(urlsplit(req.path).query)
+        window = qs.get("window", ["5m"])[0]
+        if window not in WINDOW_S:
+            raise ConfigError(
+                f"window must be one of {sorted(WINDOW_S)}, "
+                f"got {window!r}")
+        name = qs.get("series", [None])[0]
+        if name is None:
+            return json_response(200, {
+                "series": tel.series_names(),
+                "windows": sorted(WINDOW_S, key=WINDOW_S.get),
+                "interval_s": tel.interval_s,
+                "stats": tel.stats(),
+            })
+        if name not in tel.KINDS:
+            return json_response(404, {
+                "error": f"no series {name!r}",
+                "series": tel.series_names()})
+        return json_response(200, {
+            "series": name,
+            "kind": tel.KINDS[name],
+            "window": window,
+            "interval_s": tel.interval_s,
+            "points": tel.points(name, WINDOW_S[window]),
+        })
 
     # -- distributed trace assembly (GET /debug/trace/<trace_id>) ----------
 

@@ -1,0 +1,628 @@
+"""Telemetry history + SLO burn-rate engine (ISSUE 15).
+
+Five contracts:
+
+* digest accuracy — ``WindowedDigest`` quantiles vs ``numpy.percentile``
+  on adversarial distributions.  The digest's guarantee is RANK-relative
+  (the estimate is within ``alpha`` relative error of a true sample at
+  that rank), so each estimate must either sit within ~alpha of
+  ``numpy.percentile`` or, where numpy interpolates across a density gap
+  the data never occupied (bimodal p50), place the right fraction of
+  samples at or below it (rank error <= 1%);
+* window expiry/rotation under a fake clock, including a full ring wrap
+  reusing a slice position (epoch disambiguation);
+* the burn-rate state machine — multi-window discipline (a fast-window
+  spike with a calm slow window stays quiet), immediate worsening,
+  flap-damped recovery, freshness thresholds, armed-only scrape
+  families;
+* the cluster ``/slo`` roll-up — transition totals summed exactly from
+  cumulative per-node counts, unarmed peers counted as not reporting,
+  dead peers flagged ``partial`` with their stale snapshot retained;
+* default-off purity — an unarmed process's scrape text carries none of
+  the new families (the shared portion is byte-identical to an armed
+  process's under the same traffic) and its trace stream never mentions
+  SLOs; unarmed endpoints answer a 404 naming ``--telemetry-interval-s``.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu.cluster import ClusterNode
+from mpi_tpu.config import ConfigError
+from mpi_tpu.obs import Obs
+from mpi_tpu.obs.slo import (
+    SloEngine, default_objectives, load_slo_file, normalize_objectives,
+)
+from mpi_tpu.obs.timeseries import TelemetryRecorder, WindowedDigest
+from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.httpd import make_server
+from mpi_tpu.serve.session import SessionManager
+
+# families that exist only after arm_telemetry()
+ARMED_FAMILIES = (
+    "mpi_tpu_slo_state",
+    "mpi_tpu_slo_transitions_total",
+    "mpi_tpu_telemetry_samples_total",
+)
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeMgr:
+    """The one manager surface the SLO engine touches."""
+
+    def __init__(self):
+        self.age = None
+
+    def last_dispatch_age_s(self):
+        return self.age
+
+
+def _armed(clock, objectives=None, damp_evals=3, mgr=None):
+    obs = Obs()
+    mgr = mgr or _FakeMgr()
+    tel = obs.arm_telemetry(interval_s=5.0, manager=mgr,
+                            objectives=objectives, damp_evals=damp_evals,
+                            clock=clock, start=False)
+    return obs, tel, obs.slo, mgr
+
+
+# ------------------------------------------------ digest accuracy
+
+
+def _distributions(n=20000):
+    rng = np.random.default_rng(7)
+    half = n // 2
+    return {
+        "uniform": rng.uniform(1e-4, 10.0, n),
+        # two tight modes three decades apart: p50 falls in the density
+        # gap, where numpy interpolates a value no sample ever took
+        "bimodal": np.abs(np.concatenate([
+            rng.normal(3e-3, 5e-4, half), rng.normal(0.3, 0.02, half)])),
+        "heavy_tail": rng.pareto(1.5, n) + 1e-3,
+        "lognormal": rng.lognormal(-5.0, 2.0, n),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_distributions(100)))
+def test_digest_quantiles_track_numpy_percentile(name):
+    data = _distributions()[name]
+    clock = _FakeClock(1000.0)
+    dig = WindowedDigest(alpha=0.05, clock=clock)
+    for v in data:
+        dig.observe(float(v))
+    assert dig.count(3600.0, now=clock.t) == len(data)
+    for q in (0.5, 0.95, 0.99):
+        est = dig.quantile(q, 3600.0, now=clock.t)
+        true = float(np.percentile(data, q * 100.0))
+        rel = abs(est - true) / true
+        # rank error: the fraction of samples at or below the estimate
+        # must land within 1% of q — the digest's actual guarantee when
+        # numpy's interpolated value sits in a density gap
+        rank_err = abs(float(np.mean(data <= est)) - q)
+        assert rel <= 0.055 or rank_err <= 0.011, (
+            f"{name} q={q}: est={est:.6g} true={true:.6g} "
+            f"rel={rel:.4f} rank_err={rank_err:.4f}")
+
+
+def test_digest_fraction_above_straddling_bucket_counts_under():
+    clock = _FakeClock(0.0)
+    dig = WindowedDigest(alpha=0.05, clock=clock)
+    for _ in range(10):
+        dig.observe(1.0)          # exactly at the threshold
+    assert dig.fraction_above(1.0, 60.0, now=0.0) == 0.0
+    for _ in range(10):
+        dig.observe(1.5)          # well above (> gamma * 1.0)
+    assert dig.fraction_above(1.0, 60.0, now=0.0) == pytest.approx(0.5)
+
+
+def test_digest_empty_and_validation():
+    dig = WindowedDigest(clock=_FakeClock())
+    assert dig.quantile(0.5, 60.0) is None
+    assert dig.summary(60.0)["count"] == 0
+    assert dig.fraction_above(1.0, 60.0) == 0.0
+    with pytest.raises(ValueError):
+        WindowedDigest(alpha=1.5)
+
+
+# ------------------------------------------------ window expiry/rotation
+
+
+def test_digest_windows_expire_under_fake_clock():
+    clock = _FakeClock(0.0)
+    dig = WindowedDigest(clock=clock)
+    for _ in range(10):
+        dig.observe(0.1)              # epoch 0
+    clock.t = 50.0
+    for _ in range(5):
+        dig.observe(0.2)              # epoch 10
+    assert dig.count(60.0, now=50.0) == 15
+    # 70s in: the epoch-0 slice has aged out of the 1m window
+    assert dig.count(60.0, now=70.0) == 5
+    # ... and at 400s both are out of 1m but inside 1h
+    assert dig.count(60.0, now=400.0) == 0
+    assert dig.count(3600.0, now=400.0) == 15
+    summ = dig.summary(3600.0, now=400.0)
+    assert summ["count"] == 15 and summ["p50"] is not None
+
+
+def test_digest_ring_wrap_reuses_slice_position():
+    clock = _FakeClock(0.0)
+    dig = WindowedDigest(max_window_s=3600.0, clock=clock)
+    for _ in range(7):
+        dig.observe(0.1)              # epoch 0, ring position 0
+    # one full ring later the same position is reused: the stored epoch
+    # marks the old slice stale, so counts overwrite instead of merging
+    clock.t = dig._nslices * WindowedDigest.SLICE_S
+    for _ in range(2):
+        dig.observe(0.1)
+    assert dig.count(3600.0, now=clock.t) == 2
+
+
+def test_recorder_window_delta_and_rates_under_fake_clock():
+    clock = _FakeClock(0.0)
+    obs = Obs()
+    obs.metrics.gauge_fn("mpi_tpu_sessions", "live", lambda: 3)
+    tel = TelemetryRecorder(obs.metrics, interval_s=5.0, clock=clock)
+    tel.sample_once()
+    obs.http_requests.inc(10, method="GET", path="/x", code="200")
+    clock.t = 5.0
+    tel.sample_once()
+    obs.http_requests.inc(5, method="GET", path="/x", code="200")
+    clock.t = 10.0
+    tel.sample_once()
+    assert tel.window_delta("http_requests", 4.0, now=10.0) == 5.0
+    assert tel.window_delta("http_requests", 7.5, now=10.0) == 15.0
+    # clipped to recorded history: a young process reports everything
+    assert tel.window_delta("http_requests", 9999.0, now=10.0) == 15.0
+    pts = tel.points("http_requests", 3600.0, now=10.0)
+    assert pts == [[5.0, 2.0], [10.0, 1.0]]      # rates between samples
+    assert [t for t, _ in pts] == sorted(t for t, _ in pts)
+    # gauges record raw values, not rates
+    assert tel.points("sessions", 3600.0, now=10.0) == [
+        [0.0, 3.0], [5.0, 3.0], [10.0, 3.0]]
+    assert tel.stats()["samples"] == 3
+    assert "http_5xx" in tel.series_names()
+
+
+# ------------------------------------------------ burn-rate state machine
+
+
+def test_availability_worsens_immediately_and_recovers_damped():
+    clock = _FakeClock(0.0)
+    obs, tel, slo, _ = _armed(clock)
+    tel.sample_once()                             # baseline
+    for code in ("200",) * 20 + ("500",) * 20:
+        obs.http_requests.inc(method="POST", path="/step", code=code)
+    clock.t = 10.0
+    tel.sample_once()   # evaluate rides after_sample: ratio 0.5 / budget
+    assert slo.worst() == "critical"              # worsening is immediate
+    assert slo.transitions_total() == 1
+    text = obs.render_metrics()
+    assert 'mpi_tpu_slo_state{slo="availability"} 2' in text
+    assert ('mpi_tpu_slo_transitions_total'
+            '{slo="availability",to="critical"} 1') in text
+    # recovery: good traffic pushes the bad burst out of the fast
+    # window, but the state holds until damp_evals consecutive calmer
+    # evaluations agree (flap damping)
+    for i in (1, 2):
+        obs.http_requests.inc(100, method="POST", path="/step", code="200")
+        clock.t = 10.0 + 400.0 * i
+        tel.sample_once()
+        assert slo.worst() == "critical", f"eval {i} must stay damped"
+    obs.http_requests.inc(100, method="POST", path="/step", code="200")
+    clock.t = 10.0 + 1200.0
+    tel.sample_once()
+    assert slo.worst() == "ok"
+    assert slo.transitions_total() == 2
+    snap = slo.snapshot()
+    assert snap["worst"] == "ok" and snap["evals"] == 5
+    assert {(t["slo"], t["to"]): t["count"]
+            for t in snap["transitions"]} == {
+        ("availability", "critical"): 1, ("availability", "ok"): 1}
+
+
+def test_relapse_resets_the_recovery_streak_without_ringing():
+    clock = _FakeClock(0.0)
+    obs, tel, slo, _ = _armed(clock)
+    tel.sample_once()
+    obs.http_requests.inc(20, method="POST", path="/step", code="500")
+    clock.t = 10.0
+    tel.sample_once()
+    assert slo.worst() == "critical" and slo.transitions_total() == 1
+    # two calmer evals (streak 2 of 3) ...
+    for i in (1, 2):
+        obs.http_requests.inc(50, method="POST", path="/step", code="200")
+        clock.t = 10.0 + 400.0 * i
+        tel.sample_once()
+    # ... then a relapse: the streak resets, the counter must NOT ring
+    obs.http_requests.inc(20, method="POST", path="/step", code="500")
+    clock.t += 10.0
+    tel.sample_once()
+    assert slo.worst() == "critical" and slo.transitions_total() == 1
+    for i in (1, 2):
+        obs.http_requests.inc(50, method="POST", path="/step", code="200")
+        clock.t += 400.0
+        tel.sample_once()
+        assert slo.worst() == "critical"
+
+
+def test_fast_spike_with_calm_slow_window_stays_quiet():
+    """The SRE-workbook discipline: both windows must burn before the
+    state worsens, so a 100%-bad burst on top of an hour of clean
+    traffic does not alert."""
+    clock = _FakeClock(0.0)
+    obs, tel, slo, _ = _armed(clock)
+    tel.sample_once()
+    for i in range(1, 13):                        # an hour of clean traffic
+        obs.http_requests.inc(1000, method="POST", path="/step", code="200")
+        clock.t = 300.0 * i
+        tel.sample_once()
+    assert slo.worst() == "ok"
+    obs.http_requests.inc(30, method="POST", path="/step", code="500")
+    obs.http_requests.inc(30, method="POST", path="/step", code="200")
+    clock.t = 3660.0
+    tel.sample_once()
+    avail = [r for r in slo.snapshot()["slos"]
+             if r["name"] == "availability"][0]
+    assert avail["burn"]["fast"] > 14.4           # the spike is burning...
+    assert avail["burn"]["slow"] < 6.0            # ...but not sustained
+    assert slo.worst() == "ok" and slo.transitions_total() == 0
+    # sustain the burn and both windows agree: critical
+    obs.http_requests.inc(300, method="POST", path="/step", code="500")
+    clock.t = 3670.0
+    tel.sample_once()
+    assert slo.worst() == "critical"
+
+
+def test_freshness_thresholds_and_never_dispatched():
+    clock = _FakeClock(0.0)
+    obs, tel, slo, mgr = _armed(clock, damp_evals=1)
+    tel.sample_once()                 # age None: no data, not stale
+    assert slo.worst() == "ok"
+    mgr.age = 480.0                   # 80% of the 600s default max_age
+    clock.t = 10.0
+    tel.sample_once()
+    assert [r["state"] for r in slo.snapshot()["slos"]
+            if r["name"] == "freshness"] == ["warning"]
+    mgr.age = 700.0                   # past max_age
+    clock.t = 20.0
+    tel.sample_once()
+    assert slo.worst() == "critical"
+    mgr.age = 30.0
+    clock.t = 30.0
+    tel.sample_once()                 # damp_evals=1: recovers at once
+    assert slo.worst() == "ok"
+
+
+def test_latency_objective_burns_on_fraction_over_threshold():
+    clock = _FakeClock(0.0)
+    obs, tel, slo, _ = _armed(clock, objectives=[
+        {"name": "lat", "type": "latency", "path": "dispatch",
+         "threshold_s": 0.1, "target": 0.95}])
+    for _ in range(20):
+        tel.dispatch_digest.observe(0.01)
+    clock.t = 10.0
+    tel.sample_once()
+    assert slo.worst() == "ok"
+    for _ in range(80):
+        tel.dispatch_digest.observe(0.5)
+    clock.t = 20.0
+    tel.sample_once()                 # 80% over / 5% budget = burn 16
+    assert slo.worst() == "critical"
+    row = slo.snapshot()["slos"][0]
+    assert row["detail"]["fast"]["over_threshold"] == pytest.approx(
+        0.8, abs=0.01)
+
+
+def test_arm_telemetry_is_idempotent():
+    obs = Obs()
+    tel = obs.arm_telemetry(interval_s=5.0, start=False)
+    assert obs.arm_telemetry(interval_s=99.0, start=False) is tel
+    assert obs.telemetry is tel and obs.slo is not None
+
+
+# ------------------------------------------------ objective validation
+
+
+def test_objective_validation_names_the_offending_field():
+    cases = [
+        ({"type": "nope"}, "objective type"),
+        ({"type": "availability"}, "target must be a ratio"),
+        ({"type": "availability", "target": 1.5}, "target must be a ratio"),
+        ({"type": "latency", "target": 0.9, "path": "nope",
+          "threshold_s": 1.0}, "path must be one of"),
+        ({"type": "latency", "target": 0.9, "threshold_s": -1},
+         "threshold_s must be > 0"),
+        ({"type": "freshness", "max_age_s": 0}, "max_age_s must be > 0"),
+        ({"type": "freshness", "max_age_s": 5, "warn_burn": 3,
+          "crit_burn": 2}, "must not exceed crit_burn"),
+        ({"type": "freshness", "max_age_s": 5, "bogus": 1}, "unknown keys"),
+        ("not-a-dict", "must be an object"),
+    ]
+    for raw, msg in cases:
+        with pytest.raises(ConfigError, match=msg):
+            normalize_objectives([raw])
+    with pytest.raises(ConfigError, match="duplicate objective name"):
+        normalize_objectives([
+            {"name": "x", "type": "freshness", "max_age_s": 5},
+            {"name": "x", "type": "availability", "target": 0.99}])
+    with pytest.raises(ConfigError, match="non-empty objectives list"):
+        normalize_objectives([])
+    with pytest.raises(ConfigError, match='"objectives" list'):
+        normalize_objectives({"damp_evals": 2})
+    with pytest.raises(ConfigError, match="damp_evals must be an int"):
+        normalize_objectives({"objectives": default_objectives(),
+                              "damp_evals": 0})
+    with pytest.raises(ConfigError, match="unknown top-level keys"):
+        normalize_objectives({"objectives": default_objectives(),
+                              "bogus": 1})
+    objs, opts = normalize_objectives(
+        {"objectives": default_objectives(), "damp_evals": 5})
+    assert opts == {"damp_evals": 5} and len(objs) == 3
+
+
+def test_load_slo_file_errors_and_roundtrip(tmp_path):
+    with pytest.raises(ConfigError, match="cannot read slo file"):
+        load_slo_file(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    with pytest.raises(ConfigError, match="is not JSON"):
+        load_slo_file(str(bad))
+    good = tmp_path / "slo.json"
+    good.write_text(json.dumps({
+        "objectives": [{"name": "avail", "type": "availability",
+                        "target": 0.99, "warn_burn": 2.0,
+                        "crit_burn": 4.0}],
+        "damp_evals": 2}))
+    objs, opts = load_slo_file(str(good))
+    assert objs[0]["crit_burn"] == 4.0 and opts["damp_evals"] == 2
+
+
+# ------------------------------------------------ in-process cluster
+
+
+class _Node:
+    """One in-process serving node (the ``tests/test_cluster.py``
+    harness, reduced): manager + threaded server + ClusterNode with the
+    gossip timer effectively disabled — tests drive ``gossip_now``."""
+
+    def __init__(self, armed=True):
+        self.obs = Obs()
+        self.mgr = SessionManager(EngineCache(max_size=4), batching=False,
+                                  obs=self.obs)
+        if armed:
+            self.obs.arm_telemetry(interval_s=5.0, manager=self.mgr,
+                                   start=False)
+        self.srv = make_server("127.0.0.1", 0, self.mgr)
+        host, port = self.srv.server_address[:2]
+        self.addr = f"{host}:{port}"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.node = None
+
+    def join(self, peers, down_after_s=None):
+        self.node = ClusterNode(self.addr, peers, self.mgr,
+                                interval_s=3600.0,
+                                down_after_s=down_after_s, obs=self.obs)
+        self.mgr.attach_cluster(self.node)
+        self.srv.core.cluster = self.node
+        return self.node
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def _req(addr, method, path):
+    conn = http.client.HTTPConnection(addr, timeout=30)
+    conn.request(method, path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    try:
+        return resp.status, json.loads(data)
+    except (ValueError, UnicodeDecodeError):
+        return resp.status, data
+
+
+def _force_critical(node):
+    node.obs.telemetry.sample_once()
+    node.obs.http_requests.inc(30, method="POST", path="/step", code="500")
+    node.obs.telemetry.sample_once()
+    assert node.obs.slo.worst() == "critical"
+
+
+def test_cluster_slo_rollup_sums_transitions_exactly():
+    a, b = _Node(), _Node()
+    try:
+        a.join([b.addr])
+        b.join([a.addr])
+        _force_critical(b)
+        a.node.gossip_now()
+        st, doc = _req(a.addr, "GET", "/slo")
+        assert st == 200
+        cl = doc["cluster"]
+        assert cl["nodes"] == 2 and cl["nodes_reporting"] == 2
+        assert cl["complete"] and cl["partial"] == []
+        # cumulative per-node counts sum exactly (ledger discipline)
+        assert cl["transitions_total"] == (
+            a.obs.slo.transitions_total() + b.obs.slo.transitions_total())
+        assert cl["transitions_total"] == 1
+        assert cl["worst"] == "critical"
+        assert cl["burning"] == {"availability": "critical"}
+        assert (cl["by_node"][b.addr]["states"]
+                == b.obs.slo.compact()["states"])
+        assert cl["by_node"][a.addr]["worst"] == "ok"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cluster_slo_rollup_flags_dead_peer_partial():
+    a, b = _Node(), _Node()
+    try:
+        a.join([b.addr], down_after_s=0.2)
+        b.join([a.addr])
+        _force_critical(b)
+        a.node.gossip_now()              # a holds b's snapshot, b fresh
+        time.sleep(0.3)                  # ... until the heartbeat ages out
+        st, doc = _req(a.addr, "GET", "/slo")
+        assert st == 200
+        cl = doc["cluster"]
+        assert cl["partial"] == [b.addr] and not cl["complete"]
+        # the stale snapshot stays visible — the roll-up just admits
+        # it is incomplete
+        assert cl["by_node"][b.addr]["worst"] == "critical"
+        assert cl["nodes_reporting"] == 2
+        assert cl["transitions_total"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cluster_unarmed_peer_counts_as_not_reporting():
+    a, b = _Node(), _Node(armed=False)
+    try:
+        a.join([b.addr])
+        b.join([a.addr])
+        a.node.gossip_now()
+        st, doc = _req(a.addr, "GET", "/slo")
+        assert st == 200
+        cl = doc["cluster"]
+        assert cl["nodes"] == 2 and cl["nodes_reporting"] == 1
+        assert cl["by_node"][b.addr] is None
+        assert cl["complete"]            # b is alive, just unarmed
+        # the unarmed peer's own endpoint answers the 404 naming the flag
+        st, err = _req(b.addr, "GET", "/slo")
+        assert st == 404 and "--telemetry-interval-s" in err["error"]
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------ endpoints + purity
+
+
+def test_unarmed_endpoints_404_and_healthz_has_no_slo_block():
+    n = _Node(armed=False)
+    try:
+        for path in ("/slo", "/debug/timeseries"):
+            st, err = _req(n.addr, "GET", path)
+            assert st == 404 and "--telemetry-interval-s" in err["error"]
+        st, h = _req(n.addr, "GET", "/healthz")
+        assert st == 200 and "slo" not in h
+    finally:
+        n.close()
+
+
+def test_armed_endpoints_and_critical_slo_never_flips_healthz_ok():
+    n = _Node()
+    try:
+        _force_critical(n)
+        st, doc = _req(n.addr, "GET", "/slo")
+        assert st == 200 and doc["worst"] == "critical"
+        assert "cluster" not in doc      # no --peers, no cluster block
+        st, ts = _req(n.addr, "GET", "/debug/timeseries")
+        assert st == 200 and "http_requests" in ts["series"]
+        st, ts = _req(n.addr, "GET",
+                      "/debug/timeseries?series=http_requests&window=1m")
+        assert st == 200 and ts["kind"] == "counter"
+        stamps = [t for t, _ in ts["points"]]
+        assert stamps == sorted(stamps)
+        st, _err = _req(n.addr, "GET", "/debug/timeseries?window=2d")
+        assert st == 400
+        st, err = _req(n.addr, "GET", "/debug/timeseries?series=nope")
+        assert st == 404 and "no series" in err["error"]
+        # alerting is not readiness: a critical availability SLO must
+        # NOT flip the probe — restarting a process because its error
+        # budget is gone only burns it faster
+        st, h = _req(n.addr, "GET", "/healthz")
+        assert st == 200 and h["ok"] is True
+        assert h["slo"]["worst"] == "critical"
+        assert h["slo"]["burning"] == ["availability"]
+    finally:
+        n.close()
+
+
+def _drive(obs):
+    obs.http_requests.inc(method="GET", path="/x", code="200")
+    obs.http_requests.inc(method="POST", path="/step", code="500")
+    obs.dispatch_solo.observe(0.01)
+    obs.dispatch_batched.observe(0.02)
+    with obs.span("outer", kind="test"):
+        obs.event("evt", foo=1)
+
+
+def test_unarmed_scrape_is_the_armed_scrape_minus_the_new_families():
+    unarmed, armed = Obs(), Obs()
+    armed.arm_telemetry(interval_s=5.0, manager=_FakeMgr(),
+                        clock=_FakeClock(), start=False)
+    _drive(unarmed)
+    _drive(armed)
+
+    def shared(text):
+        return [ln for ln in text.splitlines()
+                if not any(f in ln for f in ARMED_FAMILIES)]
+
+    u, a = unarmed.render_metrics(), armed.render_metrics()
+    # nothing to strip on the unarmed side ...
+    assert shared(u) == u.splitlines()
+    for fam in ARMED_FAMILIES:
+        assert fam not in u and fam in a
+    # ... and stripping exactly the new families off the armed scrape
+    # leaves the unarmed text byte-identical, same line order
+    assert shared(a) == u.splitlines()
+    # the trace stream is equally silent: no slo vocabulary unarmed,
+    # and arming without a transition adds no records at all
+    u_jsonl = "\n".join(json.dumps(r, sort_keys=True)
+                        for r in unarmed.tracer.snapshot())
+    assert "slo" not in u_jsonl
+    assert ([r["name"] for r in armed.tracer.snapshot()]
+            == [r["name"] for r in unarmed.tracer.snapshot()])
+    assert unarmed.telemetry is None and unarmed.slo is None
+
+
+def test_slo_transition_emits_one_trace_event():
+    clock = _FakeClock(0.0)
+    obs, tel, slo, _ = _armed(clock)
+    tel.sample_once()
+    obs.http_requests.inc(20, method="POST", path="/step", code="500")
+    clock.t = 10.0
+    tel.sample_once()
+    recs = [r for r in obs.tracer.snapshot()
+            if r["name"] == "slo_transition"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert (rec["slo"], rec["from"], rec["to"]) == (
+        "availability", "ok", "critical")
+    assert rec["burn_fast"] > 14.4 and rec["burn_slow"] > 14.4
+
+
+def test_engine_accepts_raw_objectives_and_snapshot_shape():
+    clock = _FakeClock(0.0)
+    tel = TelemetryRecorder(Obs().metrics, interval_s=5.0, clock=clock)
+    eng = SloEngine(default_objectives(), tel, clock=clock)
+    eng.evaluate(0.0)
+    snap = eng.snapshot()
+    assert snap["windows_s"] == {"fast": 300.0, "slow": 3600.0}
+    assert {r["name"] for r in snap["slos"]} == {
+        "availability", "dispatch-p99", "freshness"}
+    for row in snap["slos"]:
+        assert row["state"] == "ok"
+        assert set(row["burn"]) == {"fast", "slow"}
+        assert row["thresholds"]["warn"] <= row["thresholds"]["crit"]
+    assert set(snap["windows"]) == {"dispatch", "http", "ticket_wait"}
+    compact = eng.compact()
+    assert compact["worst"] == "ok" and compact["transitions"] == 0
+    assert set(compact["windows"]) == {"dispatch", "http", "ticket_wait"}

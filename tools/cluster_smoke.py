@@ -35,7 +35,15 @@ order:
    within the heartbeat thresholds, adopt its sessions from the shared
    state dir by deterministic replay, and answer every orphan
    **byte-identically** to its pre-kill snapshot (requests inside the
-   failover window may answer 503, which must carry ``Retry-After``).
+   failover window may answer 503, which must carry ``Retry-After``);
+6. **SLO roll-up** (ISSUE 15) — the 2-process group runs UNARMED, so
+   its ``/slo`` must 404 naming ``--telemetry-interval-s``; the chaos
+   group runs with ``--telemetry-interval-s 0.25``, so before the kill
+   every node's ``/slo`` ``cluster`` block reports all three nodes
+   (transition totals summed exactly from the gossiped cumulative
+   counts), and after the kill the survivors flag the victim
+   ``partial`` (its stale snapshot stays in ``by_node`` only until the
+   membership machine confirms death and tombstones the peer away).
 
 Exit-code contract (shared with the other ``tools/ci_gate.sh`` stages):
 0 clean, 1 findings, 2 internal error.  Needs jax only inside the
@@ -118,6 +126,7 @@ def _spawn_chaos(port, peer_ports, state_dir, faults=None):
            "--peer-down-s", str(CHAOS_DOWN_S),
            "--peer-dead-s", str(CHAOS_DEAD_S),
            "--state-dir", state_dir,
+           "--telemetry-interval-s", "0.25",
            "--no-batch"]
     if faults:
         cmd += ["--inject-faults", faults]
@@ -184,6 +193,11 @@ def main() -> int:
             return 2
         print(f"cluster_smoke: group up ({a} tag {node_tag(a)}, "
               f"{b} tag {node_tag(b)})")
+
+        # this group runs unarmed: the armed-only surface must not exist
+        st, err = _req(a, "GET", "/slo")
+        check(st == 404 and "--telemetry-interval-s" in err.get("error", ""),
+              f"unarmed /slo answers a 404 naming the flag ({st})")
 
         # -- 1: sticky routing + transparent proxy -----------------------
         print("stage 1: sticky routing / transparent proxy")
@@ -443,6 +457,41 @@ def main() -> int:
               "the partition healed once the fault clause expired "
               "(all three mutually alive, no restart)")
 
+        # -- 5b: armed /slo roll-up, complete while all three live -------
+        print("stage 5b: cluster /slo roll-up (armed group)")
+
+        def _slo_complete():
+            st, doc = _req(nodes[0], "GET", "/slo")
+            if st != 200:
+                return None
+            cl = doc.get("cluster")
+            if (cl and cl["nodes"] == 3 and cl["nodes_reporting"] == 3
+                    and cl["complete"] and not cl["partial"]
+                    and all(cl["by_node"].values())):
+                return cl
+            return None
+        rollup = _poll(10 * GOSSIP_S, _slo_complete)
+        if not check(rollup is not None,
+                     "all three armed nodes report in the /slo roll-up "
+                     "(nodes_reporting == 3, complete, every snapshot "
+                     "present)"):
+            return 1
+        # exactness: the roll-up total is the SUM of each node's own
+        # cumulative transition count (the ledger discipline) — gossiped
+        # snapshots, not approximations.  No faults burn this group, so
+        # the per-node counts are stable between the reads.
+        per_node = []
+        for n in nodes:
+            st, d = _req(n, "GET", "/slo")
+            per_node.append(d["transitions_total"] if st == 200 else None)
+        check(None not in per_node
+              and rollup["transitions_total"] == sum(per_node),
+              f"roll-up transitions_total {rollup['transitions_total']} "
+              f"== sum of per-node counts {per_node}")
+        check(all(s.get("worst") in ("ok", "warning", "critical")
+                  for s in rollup["by_node"].values()),
+              "every gossiped snapshot carries a worst state")
+
         sids5, pre = [], {}
         for i in range(6):
             front = nodes[i % 3]
@@ -507,6 +556,34 @@ def main() -> int:
         check(bool(_poll(30.0, _victim_dead)),
               "both survivors confirmed the victim dead within the "
               "heartbeat thresholds")
+
+        # -- 5c: the roll-up admits it is incomplete after the kill ------
+        def _slo_partial():
+            st, doc = _req(survivors[0], "GET", "/slo")
+            if st != 200:
+                return None
+            cl = doc.get("cluster")
+            if cl and victim in cl.get("partial", []) \
+                    and not cl["complete"]:
+                return cl
+            return None
+        partial = _poll(15.0, _slo_partial)
+        check(partial is not None,
+              "survivor /slo flags the dead victim in cluster.partial")
+        # While the victim is merely down its stale snapshot stays in
+        # by_node; once the membership machine confirms it dead the peer
+        # entry is tombstoned out of self.peers and by_node drops it.
+        # Both are legitimate here (the kill-to-poll race decides which
+        # we observe) — what must never happen is a present-but-empty
+        # entry masquerading as a report, or a survivor going missing.
+        if partial:
+            check(victim not in partial["by_node"]
+                  or bool(partial["by_node"][victim]),
+                  "the victim's by_node entry is either tombstoned away "
+                  "or a real stale snapshot, never an empty report")
+            check(all(partial["by_node"].get(s) for s in survivors),
+                  "both survivors still report real SLO snapshots in "
+                  "by_node after the kill")
 
         def _adopted_bitident():
             for sid in orphans:

@@ -19,6 +19,13 @@ carry NO ``host``/``process`` labels and none of the cluster-only
 families, while an ``Obs`` built with (or re-labelled to) an instance
 identity must stamp both labels on every sample.
 
+ISSUE 15 adds the telemetry/SLO contract, both halves: the unarmed
+server above must leak none of the armed-only families and its
+``/slo``/``/debug/timeseries`` must 404 naming ``--telemetry-interval-s``
+(default-off purity), while a second, ARMED server under forced 5xx
+(``check_slo_telemetry``) must ring availability ok -> critical on
+every surface without flipping ``/healthz`` (alerting is not readiness).
+
 This is the contract check for PR 4's tentpole: dashboards and trace
 tooling parse these two text formats, so their shape is API.  Run
 directly (``python tools/obs_smoke.py``) or via the tier-1 wrapper in
@@ -39,6 +46,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import urllib.error
 import urllib.request
 
 from mpi_tpu.analysis.obsreg import cluster_families, required_families
@@ -58,6 +66,11 @@ REQUIRED_METRICS, AIO_METRICS = required_families()
 CLUSTER_METRICS = tuple(cluster_families())
 # the per-process identity labels cluster mode stamps on every sample
 INSTANCE_LABELS = ("host", "process")
+# families registered only when --telemetry-interval-s arms the sampler
+# (ISSUE 15) — required ABSENT from the unarmed scrape main() drives,
+# required PRESENT on the armed stage's scrape below
+SLO_METRICS = ("mpi_tpu_slo_state", "mpi_tpu_slo_transitions_total",
+               "mpi_tpu_telemetry_samples_total")
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # ...and the sparse-engine step path (PR 6)
@@ -552,6 +565,27 @@ def main():
         if present:
             raise ValueError(f"single-process scrape leaked cluster-mode "
                              f"families: {present}")
+        # default-off purity (ISSUE 15): this server never armed the
+        # telemetry sampler, so the armed-only families must be absent
+        # and the armed-only endpoints must 404 naming the flag
+        present = [m for m in SLO_METRICS if m in types]
+        if present:
+            raise ValueError(f"unarmed scrape leaked armed-only slo "
+                             f"families: {present}")
+        for path in ("/slo", "/debug/timeseries"):
+            try:
+                call("GET", path)
+                raise ValueError(f"unarmed server answered GET {path}")
+            except urllib.error.HTTPError as e:
+                err = json.loads(e.read().decode())
+                if e.code != 404 or \
+                        "--telemetry-interval-s" not in err.get("error", ""):
+                    raise ValueError(
+                        f"unarmed GET {path} -> {e.code} {err}, expected "
+                        f"a 404 naming --telemetry-interval-s")
+        _, body = call("GET", "/healthz")
+        if "slo" in json.loads(body):
+            raise ValueError("unarmed /healthz leaked an slo block")
         for name, labels, _ in samples:
             leaked = [k for k in INSTANCE_LABELS if k in labels]
             if leaked:
@@ -677,6 +711,158 @@ def main():
     return 0
 
 
+def check_slo_telemetry():
+    """Armed-telemetry stage (ISSUE 15): a second server with the
+    sampler armed at a tight cadence and every tpu dispatch forced to
+    raise (``step:*:raise``, no degrade fallback, breaker threshold out
+    of reach).  The availability SLO must ring ok -> critical with the
+    transition counted on ``/slo``, the trace stream, and the scrape —
+    while ``/healthz`` stays 200/ok (alerting is not readiness) — and
+    ``/debug/timeseries`` must answer monotone-timestamped rate points
+    that actually saw the 5xx burn."""
+    from mpi_tpu.obs import Obs
+    from mpi_tpu.serve.cache import EngineCache
+    from mpi_tpu.serve.httpd import make_server
+    from mpi_tpu.serve.session import SessionManager
+
+    obs = Obs(trace_capacity=4096)
+    manager = SessionManager(
+        EngineCache(max_size=2, breaker_threshold=10 ** 6),
+        obs=obs, degrade=False, step_retries=0, batching=False,
+        faults="step:*:raise")
+    obs.arm_telemetry(interval_s=0.1, manager=manager)
+    server = make_server(port=0, manager=manager)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://{host}:{port}"
+
+    def call(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    try:
+        st, body = call("POST", "/sessions",
+                        {"rows": 16, "cols": 16, "backend": "tpu"})
+        assert st == 200, f"armed create -> {st}"
+        sid = json.loads(body)["id"]
+        st, body = call("GET", "/slo")
+        assert st == 200, f"armed /slo -> {st}"
+        doc = json.loads(body)
+        missing = {"interval_s", "evals", "windows_s", "worst", "slos",
+                   "transitions", "transitions_total",
+                   "windows"} - doc.keys()
+        if missing:
+            raise ValueError(f"/slo payload missing {sorted(missing)}")
+        if doc["windows_s"] != {"fast": 300.0, "slow": 3600.0}:
+            raise ValueError(f"/slo burn windows drifted: "
+                             f"{doc['windows_s']}")
+        names = {r["name"] for r in doc["slos"]}
+        if names != {"availability", "dispatch-p99", "freshness"}:
+            raise ValueError(f"default objectives drifted: {sorted(names)}")
+        # forced burn: every step answers 5xx, the sampler ticks at
+        # 100 ms, and the young history clips slow == fast — so both
+        # windows agree and the state machine worsens immediately
+        deadline = time.monotonic() + 60
+        while True:
+            for _ in range(5):
+                st, _body = call("POST", f"/sessions/{sid}/step",
+                                 {"steps": 1})
+                if st < 500:
+                    raise ValueError(
+                        f"faulted step answered {st}, expected 5xx")
+            st, body = call("GET", "/slo")
+            doc = json.loads(body)
+            if doc["worst"] == "critical":
+                break
+            if time.monotonic() >= deadline:
+                raise ValueError(
+                    f"availability never went critical under 100% 5xx: "
+                    f"{json.dumps(doc['slos'])[:400]}")
+            time.sleep(0.1)
+        trans = {(t["slo"], t["to"]): t["count"]
+                 for t in doc["transitions"]}
+        if trans.get(("availability", "critical"), 0) < 1:
+            raise ValueError(f"transition counter did not ring: {trans}")
+        # alerting is not readiness, live: the probe stays 200/ok while
+        # the availability budget burns at hundreds of times budget
+        st, body = call("GET", "/healthz")
+        h = json.loads(body)
+        if st != 200 or h["ok"] is not True:
+            raise ValueError(
+                f"a critical SLO flipped /healthz: {st} ok={h.get('ok')}")
+        if h.get("slo", {}).get("worst") != "critical" \
+                or "availability" not in h["slo"]["burning"]:
+            raise ValueError(f"/healthz slo block drifted: {h.get('slo')}")
+        st, text = call("GET", "/metrics")
+        types, samples = parse_prometheus(text)
+        missing = [m for m in SLO_METRICS if m not in types]
+        if missing:
+            raise ValueError(f"armed scrape missing families: {missing}")
+        if 'mpi_tpu_slo_state{slo="availability"} 2' not in text:
+            raise ValueError("armed scrape lacks the critical slo gauge")
+        rang = sum(v for n, labels, v in samples
+                   if n == "mpi_tpu_slo_transitions_total"
+                   and labels.get("slo") == "availability"
+                   and labels.get("to") == "critical")
+        if rang < 1:
+            raise ValueError(f"scrape transition counter = {rang}")
+        ticks = sum(v for n, _, v in samples
+                    if n == "mpi_tpu_telemetry_samples_total")
+        if ticks < 2:
+            raise ValueError(f"telemetry_samples_total = {ticks}, the "
+                             f"sampler thread is not ticking")
+        # the transition left exactly its trace event behind
+        rings = [r for r in obs.tracer.snapshot()
+                 if r["name"] == "slo_transition"
+                 and r.get("slo") == "availability"
+                 and r.get("to") == "critical"]
+        if len(rings) != 1:
+            raise ValueError(f"expected exactly one availability->critical"
+                             f" slo_transition trace event, got "
+                             f"{len(rings)}")
+        # /debug/timeseries: listing, then per-series monotone
+        # timestamps; the 5xx series must have seen the burn as a
+        # positive rate
+        st, body = call("GET", "/debug/timeseries")
+        listing = json.loads(body)
+        if st != 200 or "http_requests" not in listing["series"]:
+            raise ValueError(f"timeseries listing drifted: {listing}")
+        if listing["stats"]["samples"] < 2:
+            raise ValueError(f"recorder stats drifted: {listing['stats']}")
+        burn_seen = False
+        for series in ("http_requests", "http_5xx"):
+            st, body = call(
+                "GET", f"/debug/timeseries?series={series}&window=1m")
+            ts = json.loads(body)
+            if st != 200 or ts["kind"] != "counter":
+                raise ValueError(f"{series} payload drifted: {ts}")
+            stamps = [t for t, _ in ts["points"]]
+            if stamps != sorted(stamps):
+                raise ValueError(f"{series} timestamps not monotone: "
+                                 f"{stamps}")
+            if series == "http_5xx":
+                burn_seen = any(v > 0 for _, v in ts["points"])
+        if not burn_seen:
+            raise ValueError("http_5xx rates never saw the forced burn")
+        st, body = call("GET", "/debug/timeseries?series=nope")
+        if st != 404:
+            raise ValueError(f"unknown series -> {st}, expected 404")
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()
+    print(f"slo telemetry smoke OK: availability rang critical under "
+          f"forced 5xx, probe stayed ok, {int(ticks)} sampler ticks")
+    return 0
+
+
 def run_lint() -> None:
     """The static half of the drift gate: the same registry extraction
     that feeds REQUIRED_METRICS, cross-checked against the README and
@@ -705,7 +891,10 @@ if __name__ == "__main__":
         # the (slower) serve loop for pure-static CI hooks
         if "--lint" in sys.argv or "--lint-only" in sys.argv:
             run_lint()
-        sys.exit(main() if "--lint-only" not in sys.argv else 0)
+        if "--lint-only" not in sys.argv:
+            main()
+            check_slo_telemetry()
+        sys.exit(0)
     except Exception as e:  # noqa: BLE001 — nonzero exit IS the contract
         print(f"obs smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(1)

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""``top`` for the error budget: poll a serving box's ``GET /slo`` and
+render each objective's multi-window burn rate as a gauge bar, the hot
+latency-path quantiles per window, and a sparkline of any recorded
+telemetry series (from ``GET /debug/timeseries``).
+
+Stdlib only, same poll loop as ``tools/usage_top.py`` (shared via
+``tools/watch_common.py``):
+
+    python tools/slo_watch.py --url localhost:8000
+    python tools/slo_watch.py --url localhost:8000 --series http_requests
+    python tools/slo_watch.py --url localhost:8000 --once    # one frame
+    python tools/slo_watch.py --url localhost:8000 --cluster # slice view
+
+``--cluster`` renders the ``cluster`` block: one row per node (each
+peer's latest gossiped compact SLO state), the slice-wide worst state
+and exact transition total, and any dead peer flagged ``partial``.
+Exits 1 when the server answers 404 (telemetry not armed —
+``--telemetry-interval-s``), stops answering, or ``--cluster`` is asked
+of a server running without ``--peers``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from watch_common import base_url, fetch_json, fmt_s, sparkline, watch
+
+_STATE_MARK = {"ok": " ", "warning": "!", "critical": "X"}
+
+
+def fetch_slo(base: str, timeout_s: float = 10.0) -> dict:
+    return fetch_json(base, "/slo", timeout_s)
+
+
+def fetch_series(base: str, series: str, window: str,
+                 timeout_s: float = 10.0) -> dict:
+    return fetch_json(
+        base, f"/debug/timeseries?series={series}&window={window}",
+        timeout_s)
+
+
+def burn_bar(burn: float, warn: float, crit: float, width: int = 24) -> str:
+    """Burn rate as a gauge scaled so the critical threshold sits at the
+    right edge; the warn threshold renders as a ``|`` tick inside it."""
+    scale = max(crit, 1e-9)
+    filled = min(width, round(burn / scale * width))
+    tick = min(width - 1, round(warn / scale * width))
+    cells = ["█" if i < filled else "·" for i in range(width)]
+    if cells[tick] == "·":
+        cells[tick] = "|"
+    return "".join(cells)
+
+
+def render_slos(slo: dict) -> list:
+    lines = [
+        f"slo: worst={slo['worst']} — {slo['evals']} evals @ "
+        f"{slo['interval_s']}s, {slo['transitions_total']} transition(s), "
+        f"windows fast={slo['windows_s']['fast']:.0f}s "
+        f"slow={slo['windows_s']['slow']:.0f}s",
+        "",
+        f"  {'objective':<22} {'state':<9} {'burn 5m':>8} {'burn 1h':>8} "
+        f"{'gauge (| warn, edge crit)':<26} detail",
+    ]
+    for row in slo["slos"]:
+        th = row["thresholds"]
+        burn = row["burn"]
+        worst_burn = max(burn.get("fast", 0.0), burn.get("slow", 0.0))
+        detail = ", ".join(f"{k}={v}" for k, v in
+                           sorted((row.get("detail") or {}).items())) or "-"
+        lines.append(
+            f"{_STATE_MARK.get(row['state'], '?')} {row['name']:<22} "
+            f"{row['state']:<9} {burn.get('fast', 0.0):>8.3f} "
+            f"{burn.get('slow', 0.0):>8.3f} "
+            f"{burn_bar(worst_burn, th['warn'], th['crit']):<26} {detail}")
+    return lines
+
+
+def render_windows(slo: dict) -> list:
+    lines = ["", f"  {'latency path':<14} {'window':>6} {'count':>8} "
+                 f"{'p50':>9} {'p95':>9} {'p99':>9}"]
+    for path in sorted(slo.get("windows") or {}):
+        for label, summ in (slo["windows"][path] or {}).items():
+            if not summ.get("count"):
+                continue
+            lines.append(
+                f"  {path:<14} {label:>6} {summ['count']:>8} "
+                f"{fmt_s(summ['p50']):>9} {fmt_s(summ['p95']):>9} "
+                f"{fmt_s(summ['p99']):>9}")
+    if len(lines) == 2:
+        lines.append("  (no windowed observations yet)")
+    return lines
+
+
+def render_cluster(cluster: dict) -> list:
+    lines = [
+        f"cluster @ {cluster['node']} — {cluster['nodes']} node(s), "
+        f"{cluster['nodes_reporting']} reporting, "
+        f"worst={cluster['worst']}, "
+        f"{cluster['transitions_total']} transition(s)"
+        + ("" if cluster["complete"]
+           else f" — PARTIAL (down: {', '.join(cluster['partial'])})"),
+        f"  {'node':<24} {'worst':<9} {'evals':>6} {'transitions':>12} "
+        f"burning",
+    ]
+    for addr in sorted(cluster.get("by_node") or {}):
+        snap = cluster["by_node"][addr]
+        if not snap:
+            lines.append(f"  {addr:<24} (not reporting — no digest yet)")
+            continue
+        burning = ", ".join(
+            f"{n}={s}" for n, s in sorted((snap.get("states") or {}).items())
+            if s != "ok") or "-"
+        lines.append(
+            f"  {addr:<24} {snap.get('worst', '?'):<9} "
+            f"{snap.get('evals', 0):>6} {snap.get('transitions', 0):>12} "
+            f"{burning}")
+    if cluster.get("burning"):
+        lines.append("  slice burning: " + ", ".join(
+            f"{n}={s}" for n, s in sorted(cluster["burning"].items())))
+    return lines
+
+
+def render_series(payloads: list) -> list:
+    lines = [""]
+    for ts in payloads:
+        vals = [v for _, v in ts.get("points") or []]
+        unit = "/s" if ts.get("kind") == "counter" else ""
+        last = f"{vals[-1]:.3g}{unit}" if vals else "-"
+        lines.append(f"  {ts['series']:<22} [{ts['window']}] "
+                     f"{sparkline(vals):<30} last={last}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="localhost:8000",
+                    help="serving box (host:port or full http URL)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="one frame, no polling loop")
+    ap.add_argument("--cluster", action="store_true",
+                    help="render the /slo cluster block (per-node rows + "
+                         "slice-wide worst/partial)")
+    ap.add_argument("--series", action="append", default=None,
+                    metavar="NAME",
+                    help="telemetry series to sparkline (repeatable; "
+                         "default: http_requests, dispatch_seconds)")
+    ap.add_argument("--window", default="5m", choices=("1m", "5m", "1h"),
+                    help="sparkline window (default 5m)")
+    args = ap.parse_args(argv)
+    base = base_url(args.url)
+    series = args.series or ["http_requests", "dispatch_seconds"]
+
+    def fetch() -> dict:
+        slo = fetch_slo(base)
+        slo["_series"] = [fetch_series(base, s, args.window)
+                          for s in series]
+        return slo
+
+    def render_frame(slo: dict) -> str:
+        if args.cluster and not slo.get("cluster"):
+            raise ValueError(f"{base}/slo has no cluster block "
+                             f"(server started without --peers)")
+        lines = []
+        if args.cluster:
+            lines += render_cluster(slo["cluster"]) + [""]
+        lines += render_slos(slo)
+        lines += render_windows(slo)
+        lines += render_series(slo["_series"])
+        return "\n".join(lines)
+
+    return watch("slo_watch", f"{base}/slo", fetch, render_frame,
+                 interval=args.interval, once=args.once,
+                 on_404="telemetry not armed — --telemetry-interval-s")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,27 +21,14 @@ asked of a server running without ``--peers``.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
-import time
-import urllib.error
-import urllib.request
+
+from watch_common import base_url, fetch_json, fmt_big as _fmt_big, \
+    fmt_s as _fmt_s, watch
 
 
 def fetch_usage(base: str, timeout_s: float = 10.0) -> dict:
-    with urllib.request.urlopen(base + "/usage", timeout=timeout_s) as resp:
-        return json.loads(resp.read())
-
-
-def _fmt_s(v: float) -> str:
-    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
-
-
-def _fmt_big(v: float) -> str:
-    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
-        if abs(v) >= div:
-            return f"{v / div:.2f}{unit}"
-    return f"{v:.0f}"
+    return fetch_json(base, "/usage", timeout_s)
 
 
 def _cluster_row(label: str, tot: dict) -> str:
@@ -130,31 +117,21 @@ def main(argv=None) -> int:
                     help="render the /usage cluster block (per-node "
                          "columns + the server's roll-up row)")
     args = ap.parse_args(argv)
-    base = args.url if args.url.startswith("http") else f"http://{args.url}"
-    while True:
-        try:
-            usage = fetch_usage(base)
-        except urllib.error.HTTPError as e:
-            print(f"usage_top: {base}/usage -> {e.code} "
-                  f"({'--no-obs server has no ledger' if e.code == 404 else e.reason})",
-                  file=sys.stderr)
-            return 1
-        except OSError as e:
-            print(f"usage_top: cannot reach {base}: {e}", file=sys.stderr)
-            return 1
+    base = base_url(args.url)
+
+    def render_frame(usage: dict) -> str:
         if args.cluster and not usage.get("cluster"):
-            print(f"usage_top: {base}/usage has no cluster block "
-                  f"(server started without --peers)", file=sys.stderr)
-            return 1
-        if not args.once:
-            print("\x1b[2J\x1b[H", end="")     # clear, home
+            raise ValueError(f"{base}/usage has no cluster block "
+                             f"(server started without --peers)")
+        parts = []
         if args.cluster:
-            print(render_cluster(usage["cluster"]))
-            print()
-        print(render(usage, args.top), flush=True)
-        if args.once:
-            return 0
-        time.sleep(max(0.2, args.interval))
+            parts += [render_cluster(usage["cluster"]), ""]
+        parts.append(render(usage, args.top))
+        return "\n".join(parts)
+
+    return watch("usage_top", f"{base}/usage", lambda: fetch_usage(base),
+                 render_frame, interval=args.interval, once=args.once,
+                 on_404="--no-obs server has no ledger")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Shared plumbing for the stdlib polling dashboards (usage_top,
+slo_watch): URL normalization, one JSON fetch, the human number
+formatters, and the clear-screen poll loop with the common exit-1
+contract (404 from the server, or the server going away).
+
+Dashboards keep their own rendering; this module owns everything that
+would otherwise be copy-pasted between them."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def base_url(url: str) -> str:
+    """``host:port`` or a full URL -> ``http://host:port`` (no slash)."""
+    base = url if url.startswith("http") else f"http://{url}"
+    return base.rstrip("/")
+
+
+def fetch_json(base: str, path: str, timeout_s: float = 10.0) -> dict:
+    with urllib.request.urlopen(base + path, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def fmt_s(v: float) -> str:
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def fmt_big(v: float) -> str:
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def sparkline(values, width: int = 30) -> str:
+    """Last ``width`` samples as one block-character row (shared y-scale
+    over the shown slice; a flat series renders as its floor)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return SPARK_CHARS[0] * len(vals)
+    return "".join(
+        SPARK_CHARS[min(len(SPARK_CHARS) - 1,
+                        int((v - lo) / span * len(SPARK_CHARS)))]
+        for v in vals)
+
+
+def clear_screen() -> None:
+    print("\x1b[2J\x1b[H", end="")     # clear, home
+
+
+def watch(tool: str, path: str, fetch, render, *, interval: float,
+          once: bool, on_404: str) -> int:
+    """The poll loop every dashboard shares: fetch -> render -> sleep.
+
+    ``fetch(base-relative ignored)`` is a zero-arg callable returning the
+    payload (it may raise); ``render(payload)`` returns the frame text or
+    raises ``SystemExit``-free ``ValueError`` with a message to print and
+    exit 1 on (contract violations like a missing cluster block).
+    ``on_404`` names what a 404 means for this tool's endpoint."""
+    while True:
+        try:
+            payload = fetch()
+        except urllib.error.HTTPError as e:
+            print(f"{tool}: {path} -> {e.code} "
+                  f"({on_404 if e.code == 404 else e.reason})",
+                  file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"{tool}: cannot reach server: {e}", file=sys.stderr)
+            return 1
+        try:
+            frame = render(payload)
+        except ValueError as e:
+            print(f"{tool}: {e}", file=sys.stderr)
+            return 1
+        if not once:
+            clear_screen()
+        print(frame, flush=True)
+        if once:
+            return 0
+        time.sleep(max(0.2, interval))

@@ -1123,6 +1123,110 @@ def serve_bench_obs() -> None:
     print(json.dumps(out))
 
 
+def serve_bench_admission() -> None:
+    """`python bench.py --serve-admission`: the admission-overhead gate.
+
+    Steps the same dispatch-bound 64x64 board through an unarmed
+    manager and one with admission ARMED WITH HEADROOM — a tenant whose
+    device-seconds/cells/session quotas are real (the window math runs)
+    but orders of magnitude above what the bench spends, so every
+    request admits.  The armed variant pays the full per-request
+    admission path the server pays: resolve + shed check + quota admit
+    (``admission_check``) before the step, and the ledger settlement
+    hook charging the window after it.  Methodology is `--serve-obs`'s
+    paired-median discipline verbatim (interleaved rotated blocks,
+    min-of-reps, per-block deltas against the same block's base, median
+    gated) with the same steady-state request-floor normalization (base
+    work + the shipped 2 ms coalescing window).  Asserts the median
+    added cost is under 2% (ISSUE 16 acceptance bar) and that the armed
+    run admitted every step — a bench that silently rejected would
+    measure the cheap path.  One JSON line, errors in "error".
+    """
+    out = {"bench": "serve_admission", "ok": False}
+    try:
+        import statistics
+
+        from mpi_tpu.admission import AdmissionControl
+        from mpi_tpu.admission.tenants import normalize_tenants
+        from mpi_tpu.obs import Obs
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.session import SessionManager
+
+        VARIANTS = ("base", "armed")
+        SHIPPED_WINDOW_MS = 2.0
+        rows = cols = 64
+        steps, blocks, reps = 400, 5, 3
+
+        mgrs, sids, adm = {}, {}, None
+        for k in VARIANTS:
+            mgr = SessionManager(EngineCache(max_size=4), obs=Obs(),
+                                 batch_window_ms=0.0)
+            tenant = None
+            if k == "armed":
+                adm = AdmissionControl(normalize_tenants([
+                    {"name": "bench", "device_s_per_window": 1e9,
+                     "cells_per_window": 10 ** 15, "max_sessions": 64,
+                     "window_s": 60.0}]))
+                adm.arm(mgr, mgr.obs)
+                tenant = "bench"
+            mgrs[k] = mgr
+            sids[k] = mgr.create({"rows": rows, "cols": cols,
+                                  "backend": "tpu"}, tenant=tenant)["id"]
+            mgr.step(sids[k], 1)            # warm the depth-1 compile
+        times = {k: [] for k in VARIANTS}
+        for blk in range(blocks):
+            rot = blk % len(VARIANTS)
+            order = VARIANTS[rot:] + VARIANTS[:rot]
+            best = {k: float("inf") for k in VARIANTS}
+            for _ in range(reps):
+                for k in order:
+                    mgr, sid = mgrs[k], sids[k]
+                    check = mgr.admission_check
+                    t0 = time.perf_counter()
+                    if k == "armed":
+                        for _ in range(steps):
+                            check(sid, 1)
+                            mgr.step(sid, 1)
+                    else:
+                        for _ in range(steps):
+                            mgr.step(sid, 1)
+                    best[k] = min(best[k], time.perf_counter() - t0)
+            for k in VARIANTS:
+                times[k].append(best[k])
+        admitted = adm._decisions.get(("bench", "admit"), 0)
+        assert admitted >= steps * reps * blocks, \
+            f"armed bench admitted only {admitted} steps — rejected " \
+            f"requests would measure the cheap path"
+        deltas = [
+            (t - b) / steps / (b / steps + SHIPPED_WINDOW_MS * 1e-3) * 100.0
+            for t, b in zip(times["armed"], times["base"])]
+        overhead = statistics.median(deltas)
+        case = {
+            "board": f"{rows}x{cols}",
+            "norm_window_ms": SHIPPED_WINDOW_MS,
+            "steps_per_run": steps,
+            "blocks": blocks,
+            "reps_per_block": reps,
+            "base_step_ms": round(
+                statistics.median(times["base"]) / steps * 1e3, 4),
+            "armed_step_ms": round(
+                statistics.median(times["armed"]) / steps * 1e3, 4),
+            "added_us_per_step": round(
+                (statistics.median(times["armed"]) -
+                 statistics.median(times["base"])) / steps * 1e6, 2),
+            "block_deltas_pct": [round(d, 3) for d in deltas],
+            "overhead_pct": round(overhead, 3),
+            "steps_admitted": admitted,
+        }
+        assert overhead < 2.0, \
+            f"admission overhead {overhead:.2f}% exceeds the 2% budget"
+        out.update(ok=True, case=case,
+                   overhead_pct=case["overhead_pct"])
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 def serve_bench_async() -> None:
     """`python bench.py --serve-async`: the async-pipelining A/B.
 
@@ -1703,6 +1807,7 @@ MODES = {
     "--serve-async": lambda argv: serve_bench_async(),
     "--serve-recovery": lambda argv: serve_bench_recovery(),
     "--serve-obs": lambda argv: serve_bench_obs(),
+    "--serve-admission": lambda argv: serve_bench_admission(),
     "--serve-wire": lambda argv: serve_bench_wire(),
     "--sparse": lambda argv: sparse_bench(),
     "--tune": lambda argv: tune_bench(),

@@ -57,6 +57,9 @@ TRACE_CONTEXT_COLUMNS = ("trace_id", "span_id", "parent_span_id")
 # (ISSUE 15): present on an ARMED scrape, absent otherwise — like the
 # cluster families, they belong to neither required list
 SLO_MODULES = ("mpi_tpu/obs/slo.py", "mpi_tpu/obs/timeseries.py")
+# families registered only when --admission/--tenants-file arms the
+# admission layer (ISSUE 16) — same armed-only discipline as SLO_MODULES
+ADMISSION_PREFIX = "mpi_tpu/admission/"
 
 _BACKTICK = re.compile(r"`([^`]+)`")
 _FAMILY_TOKEN = re.compile(r"^mpi_tpu_[a-z0-9_{},*]+$")
@@ -154,11 +157,14 @@ def required_families(registry: Optional[dict] = None) -> Tuple[List[str],
     ``--peers`` and belong to neither list (see
     :func:`cluster_families`); likewise the ``SLO_MODULES`` families
     exist only when ``--telemetry-interval-s`` arms the sampler (see
-    :func:`slo_families`)."""
+    :func:`slo_families`) and the ``ADMISSION_PREFIX`` families only
+    when ``--admission``/``--tenants-file`` arms admission control
+    (see :func:`admission_families`)."""
     registry = registry or extract_registry()
     core, aio = [], []
     for name, info in sorted(registry["metrics"].items()):
         if info["module"].startswith("mpi_tpu/cluster/") \
+                or info["module"].startswith(ADMISSION_PREFIX) \
                 or info["module"] in SLO_MODULES:
             continue
         (aio if info["module"] == "mpi_tpu/serve/aio.py" else core).append(name)
@@ -182,6 +188,16 @@ def slo_families(registry: Optional[dict] = None) -> List[str]:
     registry = registry or extract_registry()
     return sorted(name for name, info in registry["metrics"].items()
                   if info["module"] in SLO_MODULES)
+
+
+def admission_families(registry: Optional[dict] = None) -> List[str]:
+    """Families registered by ``mpi_tpu/admission/`` — present on a
+    scrape only when ``--admission``/``--tenants-file`` arms admission
+    control.  The runtime smoke pins them ABSENT on an unarmed scrape
+    (the default-off purity gate) and present on an armed one."""
+    registry = registry or extract_registry()
+    return sorted(name for name, info in registry["metrics"].items()
+                  if info["module"].startswith(ADMISSION_PREFIX))
 
 
 # -- README cross-check ---------------------------------------------------

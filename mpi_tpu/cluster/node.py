@@ -75,8 +75,8 @@ class _PeerState:
     node lock)."""
 
     __slots__ = ("addr", "tag", "last_seen", "last_seq", "sessions",
-                 "ledger", "slo", "breakers_open", "added_at", "inc",
-                 "suspect")
+                 "ledger", "slo", "tenants", "breakers_open", "added_at",
+                 "inc", "suspect")
 
     def __init__(self, addr: str):
         self.addr = addr
@@ -86,6 +86,7 @@ class _PeerState:
         self.sessions = 0
         self.ledger: Optional[dict] = None          # latest totals() snapshot
         self.slo: Optional[dict] = None             # latest compact SLO state
+        self.tenants: Optional[dict] = None         # latest tenant windows
         self.breakers_open: List[str] = []
         self.added_at = time.monotonic()            # suspect clock baseline
         self.inc: Optional[float] = None            # sender incarnation
@@ -536,6 +537,12 @@ class ClusterNode:
             "slo": (mgr.obs.slo.compact()
                     if mgr.obs is not None and mgr.obs.slo is not None
                     else None),
+            # armed-only (ISSUE 16): per-tenant window-spend snapshots
+            # (absolute, merge_totals discipline — latest per node) so
+            # quotas gate against cluster-wide spend, not node slices
+            "tenants": (mgr.admission.window_snapshot()
+                        if getattr(mgr, "admission", None) is not None
+                        else None),
             "routes": self.table.snapshot_entries(),
         }
 
@@ -581,6 +588,8 @@ class ClusterNode:
             ps.ledger = ledger if isinstance(ledger, dict) else None
             slo = digest.get("slo")
             ps.slo = slo if isinstance(slo, dict) else None
+            tenants = digest.get("tenants")
+            ps.tenants = tenants if isinstance(tenants, dict) else None
             ps.breakers_open = [str(b) for b in
                                 (digest.get("breakers_open") or [])]
             breakers = list(ps.breakers_open)
@@ -732,6 +741,28 @@ class ClusterNode:
         self._gossiper.stop()
 
     # -- roll-ups ----------------------------------------------------------
+
+    def tenant_spend(self, tenant: str):
+        """Peer spend for one tenant: ``(device_s, cells, sessions)``
+        summed over each peer's latest gossiped window snapshot (the
+        QuotaGate adds the local books itself).  Same exactness contract
+        as ``usage_rollup``: absolute snapshots, latest per node, at
+        most one gossip interval stale."""
+        device_s, cells, sessions = 0.0, 0, 0
+        with self._lock:
+            snaps = [ps.tenants for ps in self.peers.values()
+                     if ps.tenants is not None]
+        for snap in snaps:
+            row = snap.get(tenant)
+            if not isinstance(row, dict):
+                continue
+            try:
+                device_s += float(row.get("device_s") or 0.0)
+                cells += int(row.get("cells") or 0)
+                sessions += int(row.get("sessions") or 0)
+            except (TypeError, ValueError):
+                continue                # junk from a peer never rejects
+        return device_s, cells, sessions
 
     def usage_rollup(self) -> dict:
         """The ``cluster`` block on ``GET /usage``: exact sums over the

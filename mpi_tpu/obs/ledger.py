@@ -96,6 +96,11 @@ class UsageLedger:
         self.cells = 0
         self.flops = 0.0
         self.by_kind = {k: 0 for k in KINDS}
+        # post-dispatch settlement hook (admission control's quota gate):
+        # called OUTSIDE the ledger lock with (kind, dur_s, riders) after
+        # every committed sync.  None (the default) costs one attribute
+        # read — unarmed behavior is unchanged.
+        self.settle_hook = None
 
     def record(self, kind: str, sig_label, dur_s: float, riders) -> None:
         """One committed sync.  ``riders`` is a sequence of
@@ -139,6 +144,9 @@ class UsageLedger:
                 if len(riders) > 1:
                     row["rides"] += 1
                     row["boards"] += len(riders)
+        hook = self.settle_hook
+        if hook is not None:
+            hook(kind, dur_s, riders)
 
     # -- read side (usage endpoint, describe/stats, scrape callbacks) -----
 

@@ -193,6 +193,12 @@ class SloEngine:
                                        for o in objectives}
         self._detail: Dict[str, dict] = {o["name"]: {} for o in objectives}
         self._evals = 0
+        # post-evaluate listeners, called OUTSIDE the lock with the
+        # engine's worst state after every evaluation — the seam the
+        # admission load-shedder hangs off; a raising listener is
+        # swallowed (the sampler must never die because an actuator
+        # hiccuped)
+        self._listeners: List[Callable[[str], None]] = []
 
     # -- burn computation --------------------------------------------------
 
@@ -272,6 +278,13 @@ class SloEngine:
                     self._streak.pop(name, None)
         with self._lock:
             self._evals += 1
+        if self._listeners:
+            worst = self.worst()
+            for fn in list(self._listeners):
+                try:
+                    fn(worst)
+                except Exception:  # noqa: BLE001 — see _listeners above
+                    pass
 
     def _transition(self, name: str, frm: str, to: str,
                     fast: float, slow: float) -> None:
@@ -290,6 +303,12 @@ class SloEngine:
         with self._lock:
             return max(self._state.values(), key=_RANK.__getitem__,
                        default="ok")
+
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        """Subscribe to post-evaluate worst-state callbacks (the
+        admission shedder's feed).  Idempotent registration is the
+        caller's problem; the engine just calls everything in order."""
+        self._listeners.append(fn)
 
     def transitions_total(self) -> int:
         with self._lock:

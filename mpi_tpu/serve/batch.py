@@ -82,7 +82,7 @@ class MicroBatcher:
         self.window_s = max(0.0, float(window_ms)) / 1e3
         self.max_batch = int(max_batch)
         self._lock = threading.Lock()
-        self._queues = {}                # (signature, steps) -> [_Entry]
+        self._queues = {}                # (signature, steps, qos) -> [_Entry]
         self.coalesced_calls = 0
         self.batched_boards = 0
         self.max_occupancy = 0
@@ -98,7 +98,10 @@ class MicroBatcher:
         blocks until the (own or some leader's) dispatch delivers.  Raises
         whatever the solo path would have raised (closed session ->
         KeyError, etc.)."""
-        key = (session.plan_sig, steps)
+        # admission tags the session with a priority class; batches
+        # compose within class only (qos is None everywhere unarmed, so
+        # the grouping — and the key — is unchanged on default servers)
+        key = (session.plan_sig, steps, getattr(session, "qos", None))
         entry = _Entry(session, steps)
         with self._lock:
             q = self._queues.get(key)

@@ -125,6 +125,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "--telemetry-interval-s 5 when that flag is unset.  "
                    "Unset: built-in defaults (availability 99.9%%, "
                    "dispatch p99 < 1s, freshness 600s)")
+    p.add_argument("--admission", action="store_true",
+                   help="arm multi-tenant admission control with the "
+                   "built-in unlimited default tenant: requests carry "
+                   "X-Gol-Tenant/X-Gol-Class headers, quotas gate in "
+                   "ledger currency, the async dispatcher schedules by "
+                   "priority class, and a critical SLO sheds low classes "
+                   "first.  Unset (and no --tenants-file): no admission "
+                   "layer runs and ids/payloads/scrape text are "
+                   "byte-identical to pre-admission builds")
+    p.add_argument("--tenants-file", default=None, metavar="PATH",
+                   help="JSON tenant registry (see README 'Admission "
+                   "control and multi-tenancy' for the schema); implies "
+                   "--admission.  Unset with --admission: one unlimited "
+                   "default tenant")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="arm POST /debug/profile?secs=N: captures a "
                    "jax.profiler device trace into DIR (off when unset)")
@@ -254,6 +268,25 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             slo_opts = {}
         obs.arm_telemetry(interval_s=telemetry_s, manager=manager,
                           objectives=objectives, **slo_opts)
+    admission_on = args.admission or bool(args.tenants_file)
+    if admission_on and obs is None:
+        print("error: --admission/--tenants-file need "
+              "observability (drop --no-obs)", file=sys.stderr)
+        return 2
+    if admission_on:
+        # after arm_telemetry: the shedder subscribes to the live SLO
+        # engine, which only exists once telemetry is armed
+        from mpi_tpu.admission import AdmissionControl
+        from mpi_tpu.admission.tenants import load_tenants_file
+
+        tenants = None
+        if args.tenants_file:
+            try:
+                tenants = load_tenants_file(args.tenants_file)
+            except ConfigError as e:
+                print(f"error: --tenants-file: {e}", file=sys.stderr)
+                return 2
+        AdmissionControl(tenants).arm(manager, obs)
     if args.front == "aio":
         from mpi_tpu.serve.aio import make_aio_server
 
@@ -332,6 +365,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         extras.append(f"telemetry {telemetry_s}s"
                       + (f" slo-file {args.slo_file}"
                          if args.slo_file else ""))
+    if admission_on:
+        extras.append("admission"
+                      + (f" tenants-file {args.tenants_file}"
+                         if args.tenants_file else " (default tenant)"))
     if args.profile_dir:
         extras.append(f"profile-dir {args.profile_dir}")
     if args.front != "threaded":

@@ -279,6 +279,12 @@ class Session:
         self.degraded_reason: Optional[str] = None
         self.restored = False               # rebuilt by replay after restart
         self.last_error: Optional[str] = None
+        # admission-control tags (ISSUE 16): the owning tenant and the
+        # tenant-default priority class.  Both stay None on an unarmed
+        # server — describe() and the batch key then behave exactly as
+        # before admission existed.
+        self.tenant: Optional[str] = None
+        self.qos: Optional[str] = None
 
     def throughput(self) -> dict:  # lint: disable=lock-discipline -- scrape-time racy read: plain attribute loads, atomic under the GIL
         gens = self.generation
@@ -354,6 +360,10 @@ class SessionManager:
         # mode — every cluster seam below is a no-op and the behavior is
         # bit-identical to the pre-cluster stack
         self.cluster = None
+        # admission control (mpi_tpu/admission): armed by
+        # AdmissionControl.arm(); None (the default) keeps every
+        # admission seam a no-op and the stack bit-identical to pre-16
+        self.admission = None
         # step listeners (the aio front's stream hub): called after every
         # committed step/board-write, often with the session lock held —
         # a listener must only flip flags and wake a poller, never block
@@ -398,6 +408,10 @@ class SessionManager:
         self.cluster = node
         if self.dispatcher is not None:
             self.dispatcher.id_suffix = f"@{node.tag}"
+        if self.admission is not None:
+            # quotas become cluster-wide: admit against gossiped peer
+            # window spend, not this node's slice
+            self.admission.attach_cluster(node)
         if self.store is not None:
             self._restore_owned(node)
             node.sync_local_sessions()
@@ -477,26 +491,39 @@ class SessionManager:
             session.closed = True
             session.grid = None
             session.engine = None
+        if self.admission is not None:
+            self.admission.gate.drop_session(sid)
 
     def session_ids(self) -> list:
         with self._lock:
             return list(self._sessions)
 
     def create(self, spec: dict, timeout_s: Optional[float] = None,
-               sid: Optional[str] = None) -> dict:
+               sid: Optional[str] = None,
+               tenant: Optional[str] = None) -> dict:
         """Create a board.  ``timeout_s`` (explicit only — the default
         budget deliberately does NOT cover create: a cold create
         legitimately spends many seconds in XLA, and an abandoned create
         worker would still register its session) bounds the build.
         ``sid`` forces the session id (cluster mode: the front that took
         the request allocates the id so ring placement and id agree);
-        None keeps the local ``s<n>`` allocation."""
+        None keeps the local ``s<n>`` allocation.  ``tenant`` (armed
+        admission only) owns the session: its concurrency cap gates the
+        create, and every step settles against its quota window."""
         deadline = _Deadline(_normalize_timeout(timeout_s))
-        return _watchdog_call(lambda: self._create(spec, sid=sid),
+        return _watchdog_call(lambda: self._create(spec, sid=sid,
+                                                   tenant=tenant),
                               deadline, "create")
 
-    def _create(self, spec: dict, sid: Optional[str] = None) -> dict:
+    def _create(self, spec: dict, sid: Optional[str] = None,
+                tenant: Optional[str] = None) -> dict:
         config, segments = _parse_spec(spec)
+        adm = self.admission
+        if adm is not None:
+            # cap check BEFORE the build — a rejected tenant must not
+            # spend compile time (enforcement precedes device work)
+            tenant = tenant if tenant is not None else adm.resolve(None)
+            adm.admit_session(tenant)
         t0 = time.perf_counter()
         with _span(self.obs, "create", backend=config.backend,
                    rows=config.rows, cols=config.cols):
@@ -514,6 +541,10 @@ class SessionManager:
                 raise ConfigError(f"session id {sid!r} already exists")
             session.id = sid
             self._sessions[sid] = session
+        if adm is not None:
+            session.tenant = tenant
+            session.qos = adm.registry.get(tenant)["default_class"]
+            adm.gate.note_session(sid, tenant)
         self._persist(session)
         info = self.describe(session)
         info["cache"] = self.cache.stats()
@@ -617,6 +648,8 @@ class SessionManager:
             session.closed = True
             session.grid = None         # free device/host buffers now; the
             session.engine = None       # cached engine survives for reuse
+        if self.admission is not None:
+            self.admission.gate.drop_session(sid)
         if self.store is not None:
             self.store.delete(sid)
         return {"id": sid, "closed": True}
@@ -768,6 +801,13 @@ class SessionManager:
             session.id = sid
             self._sessions[sid] = session
             self._next = max(self._next, recovery._sid_ordinal(sid))
+        if self.admission is not None:
+            # records don't carry tenancy; restored boards settle to the
+            # default tenant rather than escaping the books entirely
+            session.tenant = self.admission.resolve(None)
+            session.qos = self.admission.registry.get(
+                session.tenant)["default_class"]
+            self.admission.gate.note_session(sid, session.tenant)
         self.restored_sessions += 1
         self._persist(session)
 
@@ -1060,10 +1100,42 @@ class SessionManager:
         return {"id": session.id, "generation": session.generation,
                 "steps": steps}
 
+    # -- admission (ISSUE 16) ----------------------------------------------
+
+    def admission_check(self, sid: str, steps: int,
+                        tenant: Optional[str] = None,
+                        qos: Optional[str] = None) -> Optional[str]:
+        """Gate one step request BEFORE any device work: resolve the
+        request's class (tenant default, header override capped at the
+        tenant ceiling), run the shed ladder, and charge the CostCard
+        estimate against the tenant's remaining window quota.  Returns
+        the resolved class (None when admission is unarmed — the
+        transport then behaves exactly as pre-16).  Raises
+        :class:`~mpi_tpu.admission.AdmissionReject` (429), or
+        ``ConfigError`` when the header names a tenant that is not the
+        session's owner (accounting must stay honest)."""
+        adm = self.admission
+        if adm is None:
+            return None
+        session = self.get(sid)         # unknown session -> 404 first
+        owner = session.tenant if session.tenant is not None \
+            else adm.resolve(None)
+        if tenant:
+            claimed = adm.resolve(tenant)
+            if claimed != owner:
+                raise ConfigError(
+                    f"session {sid!r} belongs to tenant {owner!r}, "
+                    f"not {claimed!r}")
+        resolved = adm.resolve_class(owner, qos)
+        est_device_s, est_cells = adm.estimate(session, steps)
+        adm.admit_step(owner, resolved, est_device_s, est_cells)
+        return resolved
+
     # -- async (ticketed) stepping ----------------------------------------
 
     def step_async(self, sid: str, steps: int = 1,
-                   timeout_s: Optional[float] = None) -> dict:
+                   timeout_s: Optional[float] = None,
+                   qos: Optional[str] = None) -> dict:
         """Enqueue a step and return immediately with a ticket.  The
         budget starts NOW, at enqueue — a ticket that expires while
         queued is drained with :class:`DeadlineError` without ever
@@ -1075,10 +1147,22 @@ class SessionManager:
             raise ConfigError("async stepping is disabled (--no-async)")
         if steps < 1:
             raise ConfigError(f"steps must be >= 1, got {steps}")
-        self.get(sid)                   # unknown session -> 404 at enqueue
+        session = self.get(sid)         # unknown session -> 404 at enqueue
         deadline = _Deadline(self._budget(timeout_s))
         t0 = time.perf_counter()
-        ticket = self.dispatcher.submit(sid, steps, deadline)
+        adm = self.admission
+        if adm is None:
+            ticket = self.dispatcher.submit(sid, steps, deadline)
+        else:
+            # class + cost tags drive the dispatcher's weighted pick;
+            # the admission decision itself already ran (transport) or
+            # runs on the tenant default here (direct callers)
+            resolved = qos if qos is not None else \
+                adm.resolve_class(session.tenant if session.tenant
+                                  is not None else adm.resolve(None), None)
+            ticket = self.dispatcher.submit(
+                sid, steps, deadline, qos=resolved,
+                cost=adm.estimate_ops(session, steps))
         if self.obs is not None:
             self.obs.event("enqueue", time.perf_counter() - t0, t0,
                            sid=sid, ticket=ticket.id, steps=steps)
@@ -1263,6 +1347,10 @@ class SessionManager:
                 d["restored"] = True
             if session.last_error:
                 d["last_error"] = session.last_error
+            if session.tenant is not None:
+                # armed admission only — unarmed payloads are unchanged
+                d["tenant"] = session.tenant
+                d["class"] = session.qos
         if self.dispatcher is not None:
             # read AFTER session.lock is released: the dispatch loop
             # takes session locks while holding its own, never reversed
@@ -1376,6 +1464,11 @@ class SessionManager:
             # slice-wide roll-up: local totals + each peer's latest
             # gossiped snapshot (exact sums, at most one interval stale)
             out["cluster"] = self.cluster.usage_rollup()
+        if self.admission is not None:
+            # spend vs quota, live sessions, class mix per tenant —
+            # absent (not empty) on unarmed servers: the payload stays
+            # byte-identical to pre-16
+            out["tenants"] = self.admission.tenants_block()
         return out
 
     def slo(self) -> dict:

@@ -80,14 +80,20 @@ class Ticket:
     __slots__ = ("id", "sid", "steps", "remaining", "deadline", "status",
                  "result", "error", "event", "rid", "tctx",
                  "enqueued_mono", "done_mono", "unit_rounds",
-                 "max_batched", "callbacks")
+                 "max_batched", "callbacks", "qos", "cost")
 
-    def __init__(self, tid: str, sid: str, steps: int, deadline):
+    def __init__(self, tid: str, sid: str, steps: int, deadline,
+                 qos: str = "standard", cost: float = 0.0):
         self.id = tid
         self.sid = sid
         self.steps = int(steps)
         self.remaining = int(steps)
         self.deadline = deadline
+        # admission-control tags: priority class and the CostCard
+        # estimate (ops) used for head-of-line ordering.  Unarmed
+        # servers leave the defaults and never read them.
+        self.qos = qos
+        self.cost = float(cost)
         self.status = "pending"
         self.result: Optional[dict] = None
         self.error: Optional[BaseException] = None
@@ -154,7 +160,8 @@ class AsyncDispatcher:
 
     # -- client side (HTTP worker threads) ---------------------------------
 
-    def submit(self, sid: str, steps: int, deadline) -> Ticket:
+    def submit(self, sid: str, steps: int, deadline,
+               qos: str = "standard", cost: float = 0.0) -> Ticket:
         with self._cv:
             depth = (len(self._inbox)
                      + sum(len(q) for q in self._per_session.values()))
@@ -165,7 +172,7 @@ class AsyncDispatcher:
                     f"--async-queue-max")
             self._next += 1
             ticket = Ticket(f"t{self._next}{self.id_suffix}", sid, steps,
-                            deadline)
+                            deadline, qos=qos, cost=cost)
             self._tickets[ticket.id] = ticket
             self._inbox.append(ticket)
             self.tickets_enqueued += 1
@@ -197,6 +204,18 @@ class AsyncDispatcher:
         with self._cv:
             return sum(1 for t in self._tickets.values()
                        if t.status == "pending")
+
+    def depth_by_class(self) -> Dict[str, int]:
+        """Waiting tickets per priority class (the admission queue-depth
+        gauge; every ticket is ``standard`` on an unarmed server)."""
+        counts: Dict[str, int] = {}
+        with self._cv:
+            for t in self._inbox:
+                counts[t.qos] = counts.get(t.qos, 0) + 1
+            for q in self._per_session.values():
+                for t in q:
+                    counts[t.qos] = counts.get(t.qos, 0) + 1
+        return counts
 
     def queued_for(self, sid: str) -> int:
         with self._cv:
@@ -353,6 +372,7 @@ class AsyncDispatcher:
         from mpi_tpu.serve.session import DeadlineError
 
         manager = self.manager
+        admission = getattr(manager, "admission", None)
         with self._cv:
             for sid in list(self._per_session):
                 q = self._per_session[sid]
@@ -360,8 +380,20 @@ class AsyncDispatcher:
                     q.pop(0)
                 if not q:
                     del self._per_session[sid]
-            heads = sorted((q[0] for q in self._per_session.values()),
-                           key=lambda t: t.sid)
+            all_heads = [q[0] for q in self._per_session.values()]
+            if admission is None or not all_heads:
+                heads = sorted(all_heads, key=lambda t: t.sid)
+            else:
+                # cost-aware class scheduling: the weighted picker names
+                # the class served this round (interactive > standard >
+                # bulk, smooth 4:2:1 — no class with queued work
+                # starves), and within the class the cheapest estimated
+                # work (CostCard ops) runs first so a bulk mega-board
+                # never rides ahead of viewport traffic
+                cls = admission.picker.pick(
+                    list({t.qos for t in all_heads}))
+                heads = sorted((t for t in all_heads if t.qos == cls),
+                               key=lambda t: (t.cost, t.sid))
         # deadline drain first: the budget started at enqueue, and an
         # expired ticket must never dispatch (a queued one) nor advance
         # further (a partially-advanced one)

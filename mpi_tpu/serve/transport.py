@@ -46,6 +46,7 @@ import traceback
 from typing import Callable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from mpi_tpu.admission.quota import AdmissionReject, retry_after_header
 from mpi_tpu.cluster.proxy import (
     FORWARDED_HEADER, SESSION_ID_HEADER, PeerUnreachable, proxy_request,
 )
@@ -66,6 +67,12 @@ DEFAULT_MAX_BODY = 64 << 20             # 64 MiB
 # a scraper negotiates exemplar-capable output by naming this media type
 # in Accept; everything else gets the byte-identical Prometheus text
 OPENMETRICS_MEDIA_TYPE = "application/openmetrics-text"
+
+# admission control (ISSUE 16): the request's tenant and an optional
+# priority-class override.  Only read when admission is armed; unarmed
+# servers never look at either, so their behavior is byte-identical.
+TENANT_HEADER = "X-Gol-Tenant"
+CLASS_HEADER = "X-Gol-Class"
 
 
 class Request:
@@ -317,8 +324,31 @@ class AppCore:
         except KeyError:
             what = "ticket" if kind == "result" else "session"
             return json_response(404, {"error": f"no {what} {sid!r}"})
-        except (DeadlineError, EngineUnavailableError, EngineStepError,
-                TicketQueueFullError) as e:
+        except AdmissionReject as e:
+            # admission backpressure (quota, session cap, shed): 429
+            # with the unified structured body plus the tenant, and a
+            # Retry-After sized to when the window actually frees
+            payload = {"error": str(e), "tenant": e.tenant,
+                       "request_id": rid}
+            ctx = current_trace_context()
+            if ctx is not None:
+                payload["trace_id"] = ctx.trace_id
+            resp = json_response(429, payload)
+            resp.headers.append(retry_after_header(e.retry_after_s))
+            return resp
+        except TicketQueueFullError as e:
+            # queue-full backpressure: same 503 body as before, now with
+            # the Retry-After every backpressure rejection carries — one
+            # dispatch round (plus slack) usually frees a slot
+            payload = {"error": str(e), "request_id": rid}
+            ctx = current_trace_context()
+            if ctx is not None:
+                payload["trace_id"] = ctx.trace_id
+            resp = json_response(503, payload)
+            resp.headers.append(retry_after_header(1.0))
+            return resp
+        except (DeadlineError, EngineUnavailableError,
+                EngineStepError) as e:
             # fault-tolerance outcomes: the session survives; 503 tells
             # the client "try again / try later", never "you sent garbage"
             payload = {"error": str(e), "request_id": rid}
@@ -442,7 +472,15 @@ class AppCore:
         if kind == "sessions" and method == "POST":
             body = self._body(req, transport)
             timeout_s = self._timeout_override(req, body)
-            out = mgr.create(body, timeout_s=timeout_s, sid=forced_sid)
+            tenant = None
+            if mgr.admission is not None:
+                # tenancy binds at create: the header's tenant (default
+                # when absent) owns the session, gated by its
+                # concurrency cap inside the manager
+                tenant = mgr.admission.resolve(
+                    req.headers.get(TENANT_HEADER))
+            out = mgr.create(body, timeout_s=timeout_s, sid=forced_sid,
+                             tenant=tenant)
             if cluster is not None:
                 cluster.record_route(out["id"])
             return json_response(200, out)
@@ -479,9 +517,17 @@ class AppCore:
                 steps = body.get("steps", 1)
                 if not isinstance(steps, int):
                     raise ConfigError(f"steps must be an int, got {steps!r}")
+                # the admission decision runs BEFORE either step path —
+                # an over-quota or shed request must never reach device
+                # dispatch (no device_dispatch span, no ledger debit)
+                qos = mgr.admission_check(
+                    sid, steps,
+                    tenant=req.headers.get(TENANT_HEADER),
+                    qos=req.headers.get(CLASS_HEADER),
+                ) if mgr.admission is not None else None
                 if self._query_flag(req, "async") or bool(body.get("async")):
                     return json_response(200, mgr.step_async(
-                        sid, steps, timeout_s=timeout_s))
+                        sid, steps, timeout_s=timeout_s, qos=qos))
                 return json_response(
                     200, mgr.step(sid, steps, timeout_s=timeout_s))
             if method == "PUT" and verb == "board":
@@ -603,7 +649,9 @@ class AppCore:
         cluster = self.cluster
         raw = self._raw_body(req, transport)
         headers = {FORWARDED_HEADER: cluster.id}
-        for name in ("Content-Type", "Accept"):
+        for name in ("Content-Type", "Accept", TENANT_HEADER, CLASS_HEADER):
+            # tenancy must survive the hop: the owning node runs the
+            # admission decision, and it needs the caller's headers
             value = req.headers.get(name)
             if value:
                 headers[name] = value

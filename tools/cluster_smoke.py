@@ -19,6 +19,13 @@ order:
    front converges to the exact sum of the two per-process ledgers
    (cumulative snapshots: equality, not approximation, once gossip
    catches up);
+3b. **cluster-wide tenant quota** (ISSUE 16) — the group runs with a
+   ``--tenants-file`` capping one tenant's cells window at exactly
+   three steps' worth; after that tenant spends its whole window on a
+   session owned by one front, a step on a session owned by the OTHER
+   front must 429 (with Retry-After) before the tenant could possibly
+   have tripped the quota from that front's local books alone — the
+   rejection requires the gossiped remote spend;
 4. **kill one process** — the survivor answers structured 404s
    (``{"error": "no ticket ...", "peer": ...}``) for the dead peer's
    tickets, ``GET /debug/trace`` for the stage-1 trace answers 200
@@ -97,21 +104,22 @@ def _req_h(addr, method, path, body=None, headers=None):
         return resp.status, data, hdrs
 
 
-def _spawn(port, peer_port):
+def _spawn(port, peer_port, tenants_file=None):
     env = dict(os.environ)
     env["MPI_TPU_PLATFORM"] = "cpu"
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = ROOT
-    return subprocess.Popen(
-        [sys.executable, "-m", "mpi_tpu.cli", "serve",
-         "--host", "127.0.0.1", "--port", str(port),
-         "--peers", f"127.0.0.1:{peer_port}",
-         "--gossip-interval-s", str(GOSSIP_S),
-         "--inject-faults", FAULTS,
-         "--breaker-threshold", "1",
-         "--no-batch"],
-        env=env, cwd=ROOT, stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, text=True)
+    cmd = [sys.executable, "-m", "mpi_tpu.cli", "serve",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--peers", f"127.0.0.1:{peer_port}",
+           "--gossip-interval-s", str(GOSSIP_S),
+           "--inject-faults", FAULTS,
+           "--breaker-threshold", "1",
+           "--no-batch"]
+    if tenants_file:
+        cmd += ["--tenants-file", tenants_file]
+    return subprocess.Popen(cmd, env=env, cwd=ROOT, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
 
 
 def _spawn_chaos(port, peer_ports, state_dir, faults=None):
@@ -170,9 +178,19 @@ def main() -> int:
 
     procs = []
     try:
+        # stage 3b's tenant: the cells window fits exactly three 12x12
+        # steps (3 x 144), so a fourth step anywhere in the cluster must
+        # reject on combined spend
+        tenants_file = os.path.join(tempfile.mkdtemp(prefix="gol-tenants-"),
+                                    "tenants.json")
+        with open(tenants_file, "w") as f:
+            json.dump({"tenants": [{"name": "capped",
+                                    "cells_per_window": 432,
+                                    "window_s": 300.0}]}, f)
         for attempt in range(PORT_RETRIES):
             p1, p2 = free_port(), free_port()
-            procs = [_spawn(p1, p2), _spawn(p2, p1)]
+            procs = [_spawn(p1, p2, tenants_file),
+                     _spawn(p2, p1, tenants_file)]
             time.sleep(0.5)
             died = [p for p in procs if p.poll() is not None]
             if not died:
@@ -338,6 +356,76 @@ def main() -> int:
         if totals:
             print(f"  rolled-up totals: syncs={totals['syncs']} "
                   f"generations={totals['generations']}")
+
+        # -- 3b: cluster-wide tenant quota (ISSUE 16) --------------------
+        print("stage 3b: cluster-wide tenant quota")
+        # one capped session held by each process: tenant headers relay
+        # through the proxy, so create via either front and probe with
+        # the forwarded marker to learn who actually holds it
+        held_by = {a: None, b: None}
+        extra = 0
+        while not all(held_by.values()) and extra < 32:
+            st, out, _ = _req_h(a, "POST", "/sessions", {
+                "rows": 12, "cols": 12, "backend": "serial",
+                "seed": 200 + extra}, headers={"X-Gol-Tenant": "capped"})
+            extra += 1
+            if st != 200:
+                continue
+            for n in (a, b):
+                st, _, _ = _req_h(n, "GET",
+                                  f"/sessions/{out['id']}/snapshot",
+                                  headers={FORWARDED_HEADER: "probe"})
+                if st == 200 and held_by[n] is None:
+                    held_by[n] = out["id"]
+        if not check(all(held_by.values()),
+                     "the capped tenant holds a session on each process"):
+            return 1
+        # spend the whole window on process A's session: 3 x 144 cells
+        for i in range(3):
+            st, out = _req(a, "POST", f"/sessions/{held_by[a]}/step",
+                           {"steps": 1})
+            check(st == 200, f"capped step {i + 1}/3 on {a} -> {st}")
+        st, u = _req(a, "GET", "/usage")
+        local_a = (u.get("tenants") or {}).get("by_tenant", {}).get(
+            "capped", {})
+        check(st == 200 and local_a.get("cells") == 432,
+              f"front {a} settled the full 432-cell window locally "
+              f"({local_a.get('cells')})")
+
+        # now the OTHER front must reject on combined spend.  Each local
+        # success adds 144 cells to B's own books, and B alone would
+        # need three (432) before its local window could reject — so a
+        # 429 after at most two successes PROVES the gossiped remote
+        # spend did it
+        b_ok = 0
+        verdict = {}
+
+        def _remote_quota_429():
+            nonlocal b_ok
+            st, err, hdrs = _req_h(b, "POST",
+                                   f"/sessions/{held_by[b]}/step",
+                                   {"steps": 1})
+            if st == 200:
+                b_ok += 1
+                return None
+            verdict.update(st=st, err=err, hdrs=hdrs)
+            return verdict
+        got = _poll(20 * GOSSIP_S, _remote_quota_429)
+        if not check(got is not None and verdict["st"] == 429,
+                     f"a capped step on the other front rejected "
+                     f"({verdict.get('st')}, {b_ok} local successes)"):
+            return 1
+        check(b_ok <= 2,
+              f"the 429 needed the gossiped remote spend ({b_ok} local "
+              f"successes x 144 cells < the 432 window)")
+        err = verdict["err"]
+        check(isinstance(err, dict) and err.get("tenant") == "capped"
+              and "over cells quota" in err.get("error", "")
+              and "request_id" in err,
+              f"429 body carries the structured quota shape ({err})")
+        ra = verdict["hdrs"].get("Retry-After", "")
+        check(ra.isdigit() and int(ra) >= 1,
+              f"cluster quota 429 carries Retry-After ({ra!r})")
 
         # -- 4: kill one process -----------------------------------------
         print("stage 4: kill one process")

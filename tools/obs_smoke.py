@@ -49,7 +49,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import urllib.error
 import urllib.request
 
-from mpi_tpu.analysis.obsreg import cluster_families, required_families
+from mpi_tpu.analysis.obsreg import admission_families, cluster_families, \
+    required_families
 
 # the metric families every scrape must expose (pre-registered or bound
 # at manager attach — present even before traffic touches a site), and
@@ -71,6 +72,11 @@ INSTANCE_LABELS = ("host", "process")
 # required PRESENT on the armed stage's scrape below
 SLO_METRICS = ("mpi_tpu_slo_state", "mpi_tpu_slo_transitions_total",
                "mpi_tpu_telemetry_samples_total")
+# families registered only when --admission/--tenants-file arms the
+# admission layer (ISSUE 16) — required ABSENT from the unarmed scrape,
+# required PRESENT on check_admission's armed scrape.  Extracted, not
+# hand-listed, like the cluster set
+ADMISSION_METRICS = tuple(admission_families())
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # ...and the sparse-engine step path (PR 6)
@@ -572,6 +578,15 @@ def main():
         if present:
             raise ValueError(f"unarmed scrape leaked armed-only slo "
                              f"families: {present}")
+        # default-off purity (ISSUE 16): no --admission/--tenants-file,
+        # so the admission families must be absent and /usage must not
+        # grow a tenants block
+        present = [m for m in ADMISSION_METRICS if m in types]
+        if present:
+            raise ValueError(f"unarmed scrape leaked armed-only "
+                             f"admission families: {present}")
+        if "tenants" in usage:
+            raise ValueError("unarmed /usage leaked a tenants block")
         for path in ("/slo", "/debug/timeseries"):
             try:
                 call("GET", path)
@@ -863,6 +878,133 @@ def check_slo_telemetry():
     return 0
 
 
+def check_admission():
+    """Armed-admission stage (ISSUE 16): a second server with a real
+    two-tenant file — one tenant whose cells window cannot fit a single
+    16x16 step, one unlimited.  The capped tenant's step must answer a
+    structured 429 with a ``Retry-After`` header BEFORE any device work;
+    the roomy tenant must be wholly unaffected; the scrape must carry
+    the admission families with per-tenant decision rows; ``/usage``
+    must grow the tenants block.  (The unarmed half — families and the
+    tenants block pinned absent — runs in ``main()``.)"""
+    from mpi_tpu.admission import AdmissionControl
+    from mpi_tpu.admission.tenants import load_tenants_file
+    from mpi_tpu.obs import Obs
+    from mpi_tpu.serve.cache import EngineCache
+    from mpi_tpu.serve.httpd import make_server
+    from mpi_tpu.serve.session import SessionManager
+
+    workdir = tempfile.mkdtemp(prefix="mpi_tpu_admission_smoke_")
+    tenants_path = os.path.join(workdir, "tenants.json")
+    with open(tenants_path, "w") as f:
+        json.dump({"tenants": [
+            {"name": "capped", "cells_per_window": 64, "window_s": 60.0,
+             "max_sessions": 4},
+            {"name": "roomy"},
+        ]}, f)
+    obs = Obs(trace_capacity=4096)
+    manager = SessionManager(EngineCache(max_size=4), obs=obs,
+                             batch_window_ms=2.0)
+    AdmissionControl(load_tenants_file(tenants_path)).arm(manager, obs)
+    server = make_server(port=0, manager=manager)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://{host}:{port}"
+
+    def call(method, path, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, dict(resp.headers), resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read().decode()
+
+    try:
+        spec = {"rows": 16, "cols": 16, "backend": "tpu"}
+        st, _, body = call("POST", "/sessions", spec,
+                           {"X-Gol-Tenant": "capped"})
+        assert st == 200, f"capped create -> {st} {body}"
+        sid_capped = json.loads(body)["id"]
+        st, _, body = call("POST", "/sessions", spec,
+                           {"X-Gol-Tenant": "roomy"})
+        assert st == 200, f"roomy create -> {st} {body}"
+        sid_roomy = json.loads(body)["id"]
+
+        # one 16x16 step estimates 256 cells against a 64-cell window:
+        # rejected at admission, before any device work
+        st, hdrs, body = call("POST", f"/sessions/{sid_capped}/step",
+                              {"steps": 1})
+        if st != 429:
+            raise ValueError(f"over-quota step -> {st}, expected 429: "
+                             f"{body}")
+        err = json.loads(body)
+        missing = {"error", "tenant", "request_id", "trace_id"} - err.keys()
+        if missing:
+            raise ValueError(f"429 body missing {sorted(missing)}: {err}")
+        if err["tenant"] != "capped" or "quota" not in err["error"]:
+            raise ValueError(f"429 body drifted: {err}")
+        retry = hdrs.get("Retry-After")
+        if retry is None or not retry.isdigit() or int(retry) < 1:
+            raise ValueError(f"429 Retry-After malformed: {retry!r}")
+        # the rejection never reached the device: no dispatch span for
+        # the capped session, no ledger row
+        dispatched = [r for r in obs.tracer.snapshot()
+                      if r["name"] in ("device_dispatch",
+                                       "batched_dispatch", "host_step")
+                      and r.get("sid") == sid_capped]
+        if dispatched:
+            raise ValueError(f"over-quota step reached the device: "
+                             f"{dispatched}")
+
+        # the roomy tenant is unaffected — same server, same signature
+        st, _, body = call("POST", f"/sessions/{sid_roomy}/step",
+                           {"steps": 2})
+        if st != 200 or json.loads(body)["generation"] != 2:
+            raise ValueError(f"roomy step -> {st}: {body}")
+
+        st, _, body = call("GET", "/usage")
+        usage = json.loads(body)
+        tb = usage.get("tenants")
+        if not tb or "by_tenant" not in tb:
+            raise ValueError(f"armed /usage lacks the tenants block: "
+                             f"{list(usage)}")
+        caps = tb["by_tenant"]["capped"]
+        if caps["decisions"].get("quota", 0) < 1 or caps["cells"] != 0:
+            raise ValueError(f"capped tenant row drifted: {caps}")
+        roomy = tb["by_tenant"]["roomy"]
+        if roomy["cells"] != 512 or roomy["decisions"].get("admit", 0) < 2:
+            raise ValueError(f"roomy tenant row drifted: {roomy}")
+
+        st, _, text = call("GET", "/metrics")
+        types, samples = parse_prometheus(text)
+        missing = [m for m in ADMISSION_METRICS if m not in types]
+        if missing:
+            raise ValueError(f"armed scrape missing admission families: "
+                             f"{missing}")
+        decided = {(labels.get("tenant"), labels.get("decision")): v
+                   for n, labels, v in samples
+                   if n == "mpi_tpu_admission_decisions_total"}
+        if decided.get(("capped", "quota"), 0) < 1 \
+                or decided.get(("roomy", "admit"), 0) < 1:
+            raise ValueError(f"decision counter rows drifted: {decided}")
+        rem = {labels.get("tenant"): v for n, labels, v in samples
+               if n == "mpi_tpu_quota_remaining"}
+        if rem.get("roomy") != -1.0 or rem.get("default") != -1.0:
+            raise ValueError(f"quota_remaining rows drifted: {rem}")
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()
+    print(f"admission smoke OK: capped tenant 429'd with Retry-After "
+          f"{retry}s before device work, roomy tenant served")
+    return 0
+
+
 def run_lint() -> None:
     """The static half of the drift gate: the same registry extraction
     that feeds REQUIRED_METRICS, cross-checked against the README and
@@ -894,6 +1036,7 @@ if __name__ == "__main__":
         if "--lint-only" not in sys.argv:
             main()
             check_slo_telemetry()
+            check_admission()
         sys.exit(0)
     except Exception as e:  # noqa: BLE001 — nonzero exit IS the contract
         print(f"obs smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)

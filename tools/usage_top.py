@@ -59,6 +59,39 @@ def render_cluster(cluster: dict) -> str:
     return "\n".join(lines)
 
 
+def render_tenants(tenants: dict) -> str:
+    """Per-tenant spend vs quota and class mix, from the ``tenants``
+    block ``GET /usage`` grows when admission control is armed (ISSUE
+    16).  Quota columns show ``spent/limit`` in ledger currency; ``-``
+    marks an unlimited dimension."""
+    lines = [
+        f"admission — shed level {tenants['shed_level']}",
+        f"{'tenant':<16} {'device':>15} {'cells':>15} {'sess':>9} "
+        f"{'class':>11} mix / decisions",
+    ]
+
+    def quota(spent: str, limit) -> str:
+        return f"{spent}/{'-' if limit is None else limit}"
+
+    for name in sorted(tenants.get("by_tenant") or {}):
+        row = tenants["by_tenant"][name]
+        dev = quota(_fmt_s(row["device_s"]),
+                    None if row["device_s_per_window"] is None
+                    else _fmt_s(row["device_s_per_window"]))
+        cells = quota(_fmt_big(row["cells"]),
+                      None if row["cells_per_window"] is None
+                      else _fmt_big(row["cells_per_window"]))
+        sess = quota(str(row["sessions"]), row["max_sessions"])
+        mix = ", ".join(f"{k}={v}" for k, v in
+                        sorted((row.get("class_mix") or {}).items())) or "-"
+        dec = ", ".join(f"{k}={v}" for k, v in
+                        sorted((row.get("decisions") or {}).items())) or "-"
+        lines.append(
+            f"{name:<16} {dev:>15} {cells:>15} {sess:>9} "
+            f"{row['default_class']:>11} {mix} / {dec}")
+    return "\n".join(lines)
+
+
 def render(usage: dict, top: int) -> str:
     tot = usage["totals"]
     lines = [
@@ -126,6 +159,8 @@ def main(argv=None) -> int:
         parts = []
         if args.cluster:
             parts += [render_cluster(usage["cluster"]), ""]
+        if usage.get("tenants"):        # only when admission is armed
+            parts += [render_tenants(usage["tenants"]), ""]
         parts.append(render(usage, args.top))
         return "\n".join(parts)
 

@@ -1797,6 +1797,191 @@ def tune_bench() -> None:
     print(json.dumps(out))
 
 
+def fused_child(overlap: int, virtual_n: int) -> None:
+    """Subprocess for ``--fused``'s overlap split: the dense sharded
+    stepper at K=8 radius-2 with the stitched-band halo-compute overlap
+    on/off, over all visible devices (or ``virtual_n`` forced CPU
+    devices).  Prints one JSON line with the measured throughput."""
+    if virtual_n:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={virtual_n}"
+        ).strip()
+
+    import numpy as np
+    import jax
+
+    from mpi_tpu.utils.platform import apply_platform_override
+
+    if virtual_n:
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        apply_platform_override()
+    import jax.numpy as jnp
+
+    from mpi_tpu.backends.tpu import _pallas_single_device_mode
+    from mpi_tpu.models.rules import Rule
+    from mpi_tpu.parallel.mesh import choose_mesh_shape, make_mesh
+    from mpi_tpu.parallel.step import grid_sharding, make_sharded_stepper
+    from mpi_tpu.utils.hashinit import init_tile_np
+
+    platform = jax.devices()[0].platform
+    if not virtual_n and platform != "tpu":
+        # same masquerade guard as child(): a TPU mesh rung must not
+        # silently measure a CPU fallback
+        raise RuntimeError(f"expected tpu platform, got {platform!r}")
+    rule = Rule("r2bench", frozenset(range(8, 13)),
+                frozenset(range(9, 15)), radius=2)
+    gens, segs = 8, 8
+    shape = choose_mesh_shape(len(jax.devices()))
+    mesh = make_mesh(shape)
+    tile_r, tile_c = (1024, 2048) if platform == "tpu" else (64, 128)
+    rows, cols = shape[0] * tile_r, shape[1] * tile_c
+    use_pl, interp = _pallas_single_device_mode()
+    ev = make_sharded_stepper(
+        mesh, rule, "periodic", gens_per_exchange=gens,
+        overlap=bool(overlap), use_pallas=use_pl and not interp,
+    )
+    board = init_tile_np(rows, cols, seed=1)
+
+    def fresh():
+        # the stepper donates its input buffer — every pass needs its own
+        g = jax.device_put(jnp.asarray(board), grid_sharding(mesh))
+        return jax.block_until_ready(g)
+
+    jax.block_until_ready(ev(fresh(), gens))  # compile + warm ("setup")
+    best = 0.0
+    for _ in range(3):
+        g = fresh()
+        t0 = time.perf_counter()
+        for _ in range(segs):
+            g = ev(g, gens)               # one segment per dispatch
+        jax.block_until_ready(g)
+        best = max(best, rows * cols * gens * segs
+                   / (time.perf_counter() - t0))
+    print(json.dumps({
+        "value": best, "overlap": bool(overlap), "mesh": list(shape),
+        "rows": rows, "cols": cols, "gens": gens,
+        "platform": platform, "virtual": bool(virtual_n),
+    }))
+
+
+def fused_bench(argv=()) -> None:
+    """``--fused``: A/B of the fused temporal-blocking segment (ISSUE 17
+    tentpole — k generations per device dispatch; on TPU one
+    ``pallas_step(gens=k)`` kernel invocation, off-TPU the one compiled
+    XLA k-step program a ``comm_every=k`` segment lowers to) against the
+    per-generation chain (k dispatches of the gens=1 step).
+
+    The gate targets the dispatch-bound rung: 8192² on hardware (where
+    per-call overhead is the ~68 ms tunnel dispatch, see the module
+    docstring), 64² on the CPU fallback (where per-call overhead is the
+    jit dispatch and the 8-generation compute is comparable to it —
+    larger CPU grids are compute-bound and the split would measure XLA
+    scheduling, not dispatch amortization; the platform field keys the
+    envelope apart).  Gates: fused >= 1.3x chain AND fused segment
+    bit-identical to the chain.  Also records the overlap on/off split
+    measured over the mesh (virtual CPU mesh off-TPU).  One JSON line.
+    """
+    out = {"bench": "fused", "ok": False}
+    try:
+        import functools
+
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from mpi_tpu.models.rules import Rule
+        from mpi_tpu.ops.pallas_stencil import pallas_step, supports
+        from mpi_tpu.ops.stencil import step
+        from mpi_tpu.utils.hashinit import init_tile_np
+
+        rule = Rule("r2bench", frozenset(range(8, 13)),
+                    frozenset(range(9, 15)), radius=2)
+        gens = 8
+        platform = jax.devices()[0].platform
+        on_tpu = platform == "tpu"
+        size = 8192 if on_tpu else 64
+        segs = 8 if on_tpu else 64
+        g0 = jnp.asarray(init_tile_np(size, size, seed=1))
+        if on_tpu:
+            assert supports((size, size), rule, gens=gens)
+            fused_seg = jax.jit(functools.partial(
+                pallas_step, rule=rule, boundary="periodic", gens=gens))
+            one_gen = jax.jit(functools.partial(
+                pallas_step, rule=rule, boundary="periodic", gens=1))
+        else:
+            def _chain(g):
+                for _ in range(gens):
+                    g = step(g, rule, "periodic")
+                return g
+
+            fused_seg = jax.jit(_chain)
+            one_gen = jax.jit(lambda g: step(g, rule, "periodic"))
+
+        # parity before timing: one fused segment vs the k-call chain
+        gc = g0
+        for _ in range(gens):
+            gc = one_gen(gc)
+        bit_identical = bool(np.array_equal(
+            np.asarray(fused_seg(g0)), np.asarray(gc)))
+
+        steps = gens * segs
+
+        def timed(fn, calls_per_seg):
+            best = 0.0
+            for _ in range(5):
+                t0 = time.perf_counter()
+                g = g0
+                for _ in range(segs * calls_per_seg):
+                    g = fn(g)
+                jax.block_until_ready(g)
+                best = max(best, size * size * steps
+                           / (time.perf_counter() - t0))
+            return best
+
+        fused_cells = timed(fused_seg, 1)
+        chain_cells = timed(one_gen, gens)
+        speedup = fused_cells / chain_cells
+        gate_fused_ok = bool(speedup >= 1.3)
+
+        overlap_split = {}
+        for flag in (0, 1):
+            cp = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--fused-child", str(flag), "0" if on_tpu else "8"],
+                capture_output=True, text=True, timeout=900)
+            line = (cp.stdout.strip().splitlines() or [""])[-1]
+            if cp.returncode == 0 and line:
+                overlap_split["on" if flag else "off"] = json.loads(line)
+            else:
+                overlap_split["on" if flag else "off"] = {
+                    "error": (cp.stderr or "no output")[-400:]}
+        on_v = overlap_split.get("on", {}).get("value")
+        off_v = overlap_split.get("off", {}).get("value")
+
+        out.update(
+            ok=bool(gate_fused_ok and bit_identical),
+            metric="cell_updates_per_sec_fused_segment",
+            value=round(fused_cells), unit="cells/s",
+            platform=platform, size=size, gens=gens, plan="fused",
+            segments=segs, rule=f"R{rule.radius}",
+            fused_cells_per_s=round(fused_cells),
+            chain_cells_per_s=round(chain_cells),
+            speedup=round(speedup, 3),
+            gate_fused_ok=gate_fused_ok,
+            gate_bit_identical_ok=bit_identical,
+            overlap_split=overlap_split,
+            overlap_ratio=(round(on_v / off_v, 3)
+                           if on_v and off_v else None),
+        )
+        if not on_tpu:
+            out["degraded"] = "tpu unreachable; cpu xla fallback"
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 # mode registry: one row per `bench.py --<mode>`.  Each handler takes
 # the argv tail after the mode flag; anything unknown (or no flag at
 # all) falls through to main(), the full ladder.
@@ -1811,6 +1996,8 @@ MODES = {
     "--serve-wire": lambda argv: serve_bench_wire(),
     "--sparse": lambda argv: sparse_bench(),
     "--tune": lambda argv: tune_bench(),
+    "--fused": fused_bench,
+    "--fused-child": lambda argv: fused_child(*(int(a) for a in argv[:2])),
     "--child": lambda argv: child(*(int(a) for a in argv[:3])),
     "--mesh-child": lambda argv: mesh_child(*(int(a) for a in argv[:5])),
 }

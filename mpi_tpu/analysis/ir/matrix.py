@@ -91,6 +91,15 @@ CELLS: List[Cell] = [
     Cell("ltl_r2_1x2_dead", 64, 64, rule=_R2, boundary="dead", mesh=(1, 2),
          depth=1, tier="fast"),
     Cell("dense_bosco_1x1", 64, 64, rule="bosco", depth=1, tier="fast"),
+    # dense deep-halo + stitched-band overlap (ISSUE 17): K·r = 32
+    # exceeds the packed engines' one-ghost-word bound AND the periodic
+    # seam gate, so the run genuinely lands on the dense engine; depth 17
+    # traces segment depths {16, 1} → slab depths {32, 2}, which
+    # ir-collective holds to expected_slab_depths, and the overlap twin
+    # pins the halo-compute overlap program (interior from local data
+    # while the ppermute is in flight, k·r-deep bands stitched after)
+    Cell("dense_r2_k16_overlap_1x2", 64, 160, rule=_R2, mesh=(1, 2),
+         comm_every=16, overlap=True, depth=17, tier="fast"),
     Cell("sparse_1x1", 64, 64, sparse_tile=32, depth=2, tier="fast"),
     Cell("batched_packed_1x2", 64, 64, mesh=(1, 2), depth=2, batch=2,
          tier="fast"),
@@ -104,6 +113,12 @@ CELLS: List[Cell] = [
          depth=2),
     Cell("highlife_1x2", 64, 64, rule="highlife", mesh=(1, 2), depth=2),
     Cell("seam_1x2", 64, 80, mesh=(1, 2), depth=2),
+    Cell("dense_r2_k16_1x2", 64, 160, rule=_R2, mesh=(1, 2),
+         comm_every=16, depth=17),
+    # seam-wrapped overlap: the stitched-band body under the seam
+    # stitcher — ir-donation must keep holding the seam no-donate rule
+    # on the overlap path
+    Cell("seam_overlap_1x2", 64, 80, mesh=(1, 2), overlap=True, depth=2),
     Cell("ltl_r2_2x2_periodic", 64, 64, rule=_R2, mesh=(2, 2), depth=2),
     Cell("dense_bosco_1x1_dead", 64, 64, rule="bosco", boundary="dead",
          depth=1),
@@ -127,6 +142,8 @@ NEAR_PAIRS: List[Tuple[str, str, str]] = [
     ("packed_1x2_periodic", "highlife_1x2", "rule"),
     ("packed_2x2_dead", "packed_2x2_periodic", "boundary"),
     ("packed_w128_1x2", "packed_w128_overlap_1x2", "overlap"),
+    ("dense_r2_k16_1x2", "dense_r2_k16_overlap_1x2", "overlap"),
+    ("seam_1x2", "seam_overlap_1x2", "overlap"),
     # the 2-host shapes must be signature-distinct from each other (a
     # signature blind to the mesh would alias their executables)
     ("packed_2x4_2host", "ltl_r2_2x4_2host", "rule"),

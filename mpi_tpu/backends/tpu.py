@@ -261,11 +261,21 @@ def select_ltl_mode(config: GolConfig, mi: int, mj: int, cols=None,
     # interior still engages at depth 1)
     if pad_bits and _pallas_single_device_mode()[0]:
         return "sharded", None
-    # single device + comm_every > 1: the fused kernel has no temporal
-    # blocking, but the sharded stepper on a 1x1 mesh (self-wrapping
-    # exchange) still beats dense on TPU-class tiles; off-TPU production
-    # keeps dense (measured slower on CPU at radius 5)
+    # single device + comm_every > 1: the sharded stepper on a 1x1 mesh
+    # (self-wrapping exchange, fused bit-sliced interior in chunks) beats
+    # dense on shapes its kernel serves — but when that kernel's lane
+    # contract fails AND the fused DENSE stencil kernel can temporally
+    # block the whole segment in one pallas_call (gens·r ≤ 16), dense is
+    # no longer the XLA slow path and takes the run; off-TPU production
+    # keeps dense either way (bit-sliced measured slower on CPU at r=5)
     if config.comm_every > 1 and _pallas_single_device_mode()[0]:
+        from mpi_tpu.ops.pallas_stencil import supports as _dense_supports
+        from mpi_tpu.parallel.step import ltl_local_pallas_ok
+
+        if (not ltl_local_pallas_ok((config.rows, cols // 32), config.rule, 1)
+                and _dense_supports((config.rows, cols), config.rule,
+                                    gens=config.comm_every)):
+            return None, None
         return "sharded", None
     if config.comm_every > 1:
         return None, (
@@ -300,30 +310,56 @@ def _ltl_single_device(config: GolConfig) -> bool:
     return use
 
 
-def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int):
-    """(stepper, used_pallas) for the dense engine: on a single device
-    the fused dense Pallas kernel (ops/pallas_stencil.py, one HBM read +
-    one write per cell per step) replaces the shard_map/XLA path, which
-    would otherwise serve a higher-radius single-chip run with the
-    slowest engine.  The kernel has no temporal blocking, so an explicit
-    --comm-every > 1 keeps the sharded stepper (whose K-deep
-    self-exchange honors it) instead of being silently dropped;
-    ``overlap`` is vacuous on one device (no collective to overlap with
-    — same contract as the packed engine) and does not affect the
-    dispatch.  Multi-device meshes (and off-TPU production runs) use the
-    ppermute stepper."""
-    if n_devices == 1 and config.comm_every == 1:
+def _pick_dense_evolve(config: GolConfig, mesh, n_devices: int,
+                       depths=None, blocks=None):
+    """(stepper, used_pallas) for the dense engine: the fused dense
+    Pallas kernel (ops/pallas_stencil.py, one HBM read + one write per
+    cell per *segment* via temporal blocking) replaces the shard_map/XLA
+    path wherever its contract holds, which would otherwise serve a
+    higher-radius run with the slowest engine.
+
+    Single device: comm_every = K > 1 runs K generations in ONE
+    ``pallas_call`` (gens=K temporal blocking, bounded by K·r ≤ 16);
+    ``overlap`` is vacuous (no collective to overlap with — same
+    contract as the packed engine) and does not affect the dispatch.
+    ``blocks`` threads the tuner's (BM, SR) override.
+
+    Multi-device meshes: the ppermute stepper, with the fused kernel
+    serving each tile's *interior* (``use_pallas`` — the stitched-band
+    overlap structure) where :func:`dense_local_pallas_ok` accepts the
+    shard shape at every traced segment depth; ``used_pallas`` reports
+    whether any depth can take the kernel (the per-shape fallback keeps
+    the rest correct).  Off-TPU production runs stay pure XLA."""
+    from mpi_tpu.parallel.mesh import AXES
+    from mpi_tpu.parallel.step import dense_local_pallas_ok
+
+    use, interpret = _pallas_single_device_mode()
+    if n_devices == 1:
         from mpi_tpu.ops.pallas_stencil import make_pallas_stepper, supports
 
-        use, interpret = _pallas_single_device_mode()
-        if use and supports((config.rows, config.cols), config.rule):
+        if use and supports((config.rows, config.cols), config.rule,
+                            gens=config.comm_every):
             return make_pallas_stepper(
-                config.rule, config.boundary, interpret=interpret
+                config.rule, config.boundary, interpret=interpret,
+                gens=config.comm_every,
+                blocks=tuple(blocks) if blocks else None,
             ), True
+        return make_sharded_stepper(
+            mesh, config.rule, config.boundary,
+            gens_per_exchange=config.comm_every, overlap=config.overlap,
+        ), False
+    mi = mesh.shape[AXES[0]]
+    mj = mesh.shape[AXES[1]]
+    shard = (config.rows // mi, config.cols // mj)
+    kset = tuple(depths) if depths else (config.comm_every,)
+    used = use and any(
+        dense_local_pallas_ok(shard, config.rule, k) for k in kset
+    )
     return make_sharded_stepper(
         mesh, config.rule, config.boundary,
         gens_per_exchange=config.comm_every, overlap=config.overlap,
-    ), False
+        use_pallas=use, pallas_interpret=interpret,
+    ), used
 
 
 def _put_initial(mesh, initial, rows: int, cols: int, packed: bool,
@@ -665,12 +701,13 @@ class Engine:
 
             if self.sparse_plan is not None:
                 from mpi_tpu.ops import activity
-                # the vmapped program embeds the sparse evolve, whose
-                # persistent-cache deserialization corrupts the heap on
-                # jaxlib 0.4.37 XLA:CPU — suppress writes so a same-salt
-                # (same-process) rebuild can never read one back (see
-                # activity._CACHE_SALT)
-                evolve_batched = activity._UncachedEvolve(evolve_batched)
+                if activity._cache_optout_active():
+                    # the vmapped program embeds the sparse evolve, whose
+                    # persistent-cache deserialization corrupts the heap
+                    # on jaxlib <= 0.4.37 XLA:CPU — suppress writes so a
+                    # same-salt (same-process) rebuild can never read one
+                    # back (see activity._CACHE_SALT)
+                    evolve_batched = activity._UncachedEvolve(evolve_batched)
             self._evolve_batched = evolve_batched
         return self._evolve_batched
 
@@ -1080,7 +1117,9 @@ def build_engine(config: GolConfig, mesh=None, depths=None, tune=None,
                 blocks=blocks,
             )
     else:
-        evolve, used_pallas = _pick_dense_evolve(config, mesh, mi * mj)
+        evolve, used_pallas = _pick_dense_evolve(
+            config, mesh, mi * mj, depths=depths, blocks=blocks,
+        )
     evolve = _wrap_seam(evolve)
 
     def fallback_factory():

@@ -67,6 +67,7 @@ cells for the dense engine.  ``backends/tpu.py`` builds the
 from __future__ import annotations
 
 import contextlib
+import functools
 import math
 import os
 from dataclasses import dataclass
@@ -129,8 +130,29 @@ DEPTH_TARGET = 8
 #     in the unbounded LRU directory).
 # In-process jit caching is untouched (the salt is constant within a
 # process): still exactly one compile per (shape, depth).
+# The opt-out is version-gated (:func:`_cache_optout_active`): the root
+# cause is XLA:CPU's executable **deserialization** path in jaxlib
+# <= 0.4.37 (heap corruption when this module's full-size donated
+# while/gather/scatter program is reloaded from the persistent cache);
+# newer jaxlibs rebuilt that path, so they keep warm-cache starts.
 _CACHE_SALT: int = (
     os.getpid() ^ int.from_bytes(os.urandom(4), "little")) & 0x7FFFFFFF
+
+
+@functools.lru_cache(maxsize=1)
+def _cache_optout_active() -> bool:
+    """True when the sparse evolve must opt out of the persistent compile
+    cache: jaxlib <= 0.4.37, whose XLA:CPU corrupts the heap while
+    deserializing this module's jitted evolve (see _CACHE_SALT above).
+    Unparseable versions count as affected — the opt-out only costs a
+    recompile, the bug costs a segfault."""
+    try:
+        import jaxlib
+
+        ver = tuple(int(p) for p in jaxlib.__version__.split(".")[:3])
+    except Exception:  # pragma: no cover — version scheme changed
+        return True
+    return ver <= (0, 4, 37)
 
 
 def cache_salt() -> int:
@@ -465,8 +487,10 @@ def make_sparse_evolve(base_evolve: Callable, local_step: Callable,
         # (x*0 + salt) - salt survives into the HLO the persistent
         # cache key is computed from (pure-constant arithmetic would
         # fold eagerly during tracing and erase the salt), so this
-        # program can never hit another process's serialized executable
-        salt = jnp.int32(_CACHE_SALT)
+        # program can never hit another process's serialized executable.
+        # Salt 0 on unaffected jaxlibs: the key is then shared and
+        # warm-cache starts come back for free.
+        salt = jnp.int32(_CACHE_SALT if _cache_optout_active() else 0)
         zero = (state.changed.reshape(-1)[0].astype(jnp.int32) * 0
                 + salt) - salt
         # progress each outer round is guaranteed: any activity level is
@@ -475,7 +499,7 @@ def make_sparse_evolve(base_evolve: Callable, local_step: Callable,
                             (state.grid, state.changed, zero))
         return SparseState(st[0], st[1])
 
-    return _UncachedEvolve(evolve)
+    return _UncachedEvolve(evolve) if _cache_optout_active() else evolve
 
 
 def activity_stats(state: SparseState, plan: TilePlan) -> dict:

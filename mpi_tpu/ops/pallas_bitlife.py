@@ -137,6 +137,30 @@ def _pick_block_rows(H: int, NW: int, gens: int = 1) -> int | None:
     return picked[0] if picked else None
 
 
+def blocks_ok(H: int, NW: int, bm: int, cm: int, gens: int = 1) -> bool:
+    """Would an explicit (BM, CM) override satisfy the same alignment and
+    VMEM screens :func:`_pick_blocks` applies to its own candidates?  The
+    autotuner's candidate generator uses this to enumerate a rectangular
+    block grid without proposing shapes that are known-OOM or misaligned
+    (a bad override only costs a compile-and-fallback, never a wrong
+    answer — but proposing it wastes a tuner measurement)."""
+    halo = _halo_rows(gens)
+    if H % bm or cm > bm + 2 * halo:
+        return False
+    if halo > 8 and (H % halo or bm % halo):
+        return False
+    if NW > 512:
+        if bm > 256:  # measured VMEM OOM at wide NW, every CM and gens
+            return False
+        limit = int(15.25 * (1 << 20)) - (512 * 1024 if halo > 8 else 0)
+        need = (2 * (bm + 2 * halo) * NW * 4
+                + 11 * (cm + 2 * gens + 2) * NW * 4)
+        return need <= limit
+    limit = int(15.25 * (1 << 20))
+    room = limit - 16 * (cm + 2 * gens + 2) * NW * 4
+    return room > 0 and 2 * (bm + 2 * halo) * NW * 4 <= room
+
+
 def supports(shape, rule: Rule, gens: int = 1) -> bool:
     """(H, W) cell-space shapes this kernel handles at the given temporal
     blocking depth (deeper gens need more VMEM, so query with the gens you

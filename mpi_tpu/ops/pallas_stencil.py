@@ -27,11 +27,23 @@ multi-chip design: what ``parallel/halo.py`` does with ``ppermute``
 between chips, this does with wrapped DMAs between row blocks of one
 chip's HBM.  Reference analog: the per-cell ``next()`` sweep
 (``/root/reference/main.cpp:79-103``), here as one VPU pass per block.
+
+Temporal blocking (``gens`` > 1, the dense mirror of
+``ops/pallas_bitlife.py``): the DMA-alignment halo slab (8 rows, or 16
+when gens·r > 8) is deeper than one generation's radius needs, so after
+one HBM round-trip the slab is stepped up to ``gens`` generations in
+VMEM — each generation trims ``r`` valid rows from each side of the
+scratch window (the classic trapezoidal tiling; neighboring blocks
+recompute each other's fringe redundantly from the same input, so
+blocks stay independent), and after ``gens`` generations the middle BM
+rows are exactly ``gens`` steps ahead.  One kernel invocation replaces
+the chain of ``gens`` per-generation ``pallas_call``s a ``comm_every=k``
+segment used to issue: HBM traffic AND dispatch count both drop
+``gens``×.  Bounded by gens·r ≤ 16 (the halo slab).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -44,13 +56,25 @@ from mpi_tpu.models.rules import Rule, LIFE
 from mpi_tpu.ops.stencil import _in_any_interval
 
 
-def _pick_block_rows(H: int, W: int, radius: int) -> Optional[int]:
+def _halo_rows(gens: int, radius: int) -> int:
+    """DMA row slices must be 8-sublane aligned; the halo must also cover
+    ``radius`` consumed rows per temporally-blocked generation."""
+    return 8 if gens * radius <= 8 else 16
+
+
+def _pick_block_rows(H: int, W: int, radius: int, gens: int = 1) -> Optional[int]:
     """Largest divisor of H with block bytes in a VMEM-friendly budget."""
-    del radius  # halo slabs are a fixed 8 rows for any supported radius
-    budget = 1 << 21  # 2 MiB per double-buffer slot (uint8, +16 halo rows)
+    halo = _halo_rows(gens, radius)
+    if gens * radius > halo:
+        return None  # the trapezoid would consume more than the slab
+    if halo > 8 and H % halo:
+        return None  # wrapped halo-slab DMA starts must stay halo-aligned
+    budget = 1 << 21  # 2 MiB per double-buffer slot (uint8, + halo slabs)
     best = None
     for bm in (512, 256, 128, 64, 32, 16, 8):
-        if H % bm == 0 and (bm + 16) * W <= budget:
+        if halo > 8 and bm % halo:
+            continue
+        if H % bm == 0 and (bm + 2 * halo) * W <= budget:
             best = bm
             break
     return best
@@ -64,17 +88,35 @@ def _pick_sub_rows(BM: int, W: int) -> int:
     return sr
 
 
-def supports(shape, rule: Rule) -> bool:
-    """Shapes the kernel handles; callers fall back to the XLA path else."""
+def supports(shape, rule: Rule, gens: int = 1) -> bool:
+    """Shapes the kernel handles at the given temporal-blocking depth
+    (deeper gens need a deeper halo slab, so query with the gens you will
+    run); callers fall back to the XLA path else."""
     H, W = shape
     return (
         W % 128 == 0
-        and H >= 2 * rule.radius
-        and _pick_block_rows(H, W, rule.radius) is not None
+        and H >= 2 * rule.radius * gens
+        and _pick_block_rows(H, W, rule.radius, gens) is not None
     )
 
 
-def _make_kernel(rule: Rule, boundary: str, H: int, W: int, BM: int):
+def _out_struct(grid, H: int, W: int):
+    """Output aval for the kernel: when tracing inside ``shard_map`` the
+    result varies over the same mesh axes as the input, and shard_map's
+    vma checking requires that to be declared on the out_shape."""
+    try:
+        vma = jax.typeof(grid).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct((H, W), jnp.uint8, vma=vma)
+    return jax.ShapeDtypeStruct((H, W), jnp.uint8)
+
+
+def _make_kernel(
+    rule: Rule, boundary: str, H: int, W: int, BM: int,
+    gens: int = 1, SR: Optional[int] = None,
+):
     r = rule.radius
     win = 2 * r + 1
     periodic = boundary == "periodic"
@@ -83,10 +125,11 @@ def _make_kernel(rule: Rule, boundary: str, H: int, W: int, BM: int):
     survive_iv = rule.survive_intervals
 
     # DMA row slices must be aligned to the (8, 128) sublane tiling, so the
-    # halo slabs are a fixed 8 rows (>= r for every supported radius) and
-    # the kernel reads the r rows it needs from inside the slab.
-    HALO = 8
-    assert r <= HALO and BM % HALO == 0
+    # halo slabs are 8 rows (>= r for every supported radius) — or 16 when
+    # the temporal-blocking trapezoid consumes more than 8 (gens·r > 8) —
+    # and the kernel reads the rows it needs from inside the slab.
+    HALO = _halo_rows(gens, r)
+    assert gens * r <= HALO and BM % HALO == 0
 
     def _block_dmas(in_hbm, scratch, sems, blk, slot):
         """The three async copies loading block `blk` into scratch slot
@@ -141,6 +184,10 @@ def _make_kernel(rule: Rule, boundary: str, H: int, W: int, BM: int):
         scratch = dbuf.at[slot]
 
         if not periodic:
+            # Zero the whole edge slabs: rows beyond the grid are dead.
+            # (This only establishes the gen-0 state — during multi-gen
+            # loops the slab rows adjacent to live grid rows can be "born"
+            # and must be re-killed after every generation; see below.)
             @pl.when(i == 0)
             def _():
                 scratch[0:HALO, :] = jnp.zeros((HALO, W), dtype=jnp.uint8)
@@ -151,18 +198,17 @@ def _make_kernel(rule: Rule, boundary: str, H: int, W: int, BM: int):
 
         # Mosaic vector arithmetic needs i16/i32 and lane rotates need i32,
         # so sums are computed widened — but widening the whole block would
-        # blow VMEM at large widths.  Process the block in row sub-tiles:
-        # only (SR, W) i32 temporaries are ever live.
-        SR = _pick_sub_rows(BM, W)
-        lane = (
-            None if periodic
-            else lax.broadcasted_iota(jnp.int32, (SR, W), dimension=1)
-        )
-        for s0 in range(0, BM, SR):
-            lo = HALO - r + s0
-            v = scratch[lo : lo + SR, :].astype(jnp.int32)
+        # blow VMEM at large widths.  Process each generation's row window
+        # in sub-tiles: only (rows <= SR, W) i32 temporaries are ever live.
+        sr = SR if SR is not None else _pick_sub_rows(BM, W)
+        assert sr >= r  # the saved-rows carry holds exactly r rows
+
+        def sub_gen(wn, rows):
+            """Next state of the middle ``rows`` rows of window ``wn``
+            ((rows + 2r, W) uint8, the pre-generation state)."""
+            v = wn[0:rows, :].astype(jnp.int32)
             for k in range(1, win):
-                v = v + scratch[lo + k : lo + k + SR, :].astype(jnp.int32)
+                v = v + wn[k : k + rows, :].astype(jnp.int32)
             # horizontal window sum via lane rotations; pltpu.roll takes
             # non-negative shifts: shift s rotates lanes right (column j
             # reads j-s); the left rotation is shift W-s.
@@ -171,20 +217,67 @@ def _make_kernel(rule: Rule, boundary: str, H: int, W: int, BM: int):
                 for s in range(1, r + 1):
                     h = h + pltpu.roll(v, s, axis=1) + pltpu.roll(v, W - s, axis=1)
             else:
+                lane = lax.broadcasted_iota(jnp.int32, (rows, W), dimension=1)
                 zero = jnp.zeros_like(v)
                 for s in range(1, r + 1):
                     left = jnp.where(lane >= s, pltpu.roll(v, s, axis=1), zero)
                     right = jnp.where(lane < W - s, pltpu.roll(v, W - s, axis=1), zero)
                     h = h + left + right
-            center = scratch[HALO + s0 : HALO + s0 + SR, :].astype(jnp.int32)
+            center = wn[r : r + rows, :].astype(jnp.int32)
             counts = h - center
             # keep the select in i32 lanes; a single i32->i8 truncation at
             # the store is the only narrow op Mosaic needs to handle
             born = _in_any_interval(counts, birth_iv).astype(jnp.int32)
             keep = _in_any_interval(counts, survive_iv).astype(jnp.int32)
-            out_ref[s0 : s0 + SR, :] = jnp.where(center != 0, keep, born).astype(
-                jnp.uint8
-            )
+            return jnp.where(center != 0, keep, born).astype(jnp.uint8)
+
+        # Each generation consumes r valid rows from each side of the slab;
+        # only rows that later generations (or the output block) still need
+        # are recomputed.  Within a generation the row window is evaluated
+        # in SR-row sub-tiles; the update is in place, so each sub-tile's
+        # top r neighbor rows (overwritten by the previous sub-tile) are
+        # carried in ``saved``.  All bounds are Python ints — fully static.
+        lo, hi = 0, BM + 2 * HALO
+        for g in range(gens):
+            rem = gens - 1 - g  # generations still to run after this one
+            glo = max(lo + r, HALO - rem * r)
+            ghi = min(hi - r, HALO + BM + rem * r)
+            saved = None
+            a = glo
+            while a < ghi:
+                b = min(a + sr, ghi)
+                rows = b - a
+                # pre-generation rows [a - r, b + r): the top r rows were
+                # overwritten by the previous sub-tile and ride in `saved`
+                top = scratch[a - r : a, :] if saved is None else saved
+                wn = jnp.concatenate([top, scratch[a : b + r, :]], axis=0)
+                if rem:
+                    saved = scratch[b - r : b, :]  # old value, read pre-write
+                new = sub_gen(wn, rows)
+                if rem:
+                    scratch[a:b, :] = new
+                else:
+                    out_ref[a - HALO : b - HALO, :] = new
+                a = b
+            if rem:
+                if not periodic:
+                    # Rows beyond the grid edge are not real cells: live
+                    # grid neighbors would "give birth" into them — re-kill
+                    # them after every in-VMEM generation at the edge blocks.
+                    if glo < HALO:
+                        @pl.when(i == 0)
+                        def _():
+                            scratch[glo:HALO, :] = jnp.zeros(
+                                (HALO - glo, W), dtype=jnp.uint8
+                            )
+
+                    if ghi > HALO + BM:
+                        @pl.when(i == nblocks - 1)
+                        def _():
+                            scratch[HALO + BM : ghi, :] = jnp.zeros(
+                                (ghi - HALO - BM, W), dtype=jnp.uint8
+                            )
+                lo, hi = glo, ghi
 
     return kernel
 
@@ -194,47 +287,66 @@ def pallas_step(
     rule: Rule = LIFE,
     boundary: str = "periodic",
     interpret: bool = False,
+    gens: int = 1,
+    blocks: tuple[int, int] | None = None,
 ) -> jax.Array:
-    """One generation on a single device via the fused kernel.
-    Requires ``supports(grid.shape, rule)``."""
+    """``gens`` generations (default one) on a single device via the fused
+    kernel, in a single HBM round-trip.  Requires
+    ``supports(grid.shape, rule, gens)``.  ``blocks`` overrides the
+    auto-picked (BM, SR) DMA-block/sub-tile rows (the autotuner's knob)."""
     H, W = grid.shape
-    BM = _pick_block_rows(H, W, rule.radius)
-    if BM is None or not supports(grid.shape, rule):
+    BM, SR = blocks if blocks else (None, None)
+    if BM is None:
+        BM = _pick_block_rows(H, W, rule.radius, gens)
+    if BM is None or not supports(grid.shape, rule, gens):
         raise ValueError(
-            f"pallas_step does not support shape {grid.shape} "
+            f"pallas_step does not support shape {grid.shape} at gens={gens} "
             f"(need W % 128 == 0 and a VMEM-sized row-block divisor of H)"
         )
-    r = rule.radius
-    kernel = _make_kernel(rule, boundary, H, W, BM)
+    if gens > 1 and 0 in rule.birth:
+        # dead-boundary halo rows must stay dead across in-VMEM generations
+        raise ValueError("gens > 1 requires a rule without birth-on-0")
+    HALO = _halo_rows(gens, rule.radius)
+    kernel = _make_kernel(rule, boundary, H, W, BM, gens, SR)
     return pl.pallas_call(
         kernel,
         grid=(H // BM,),
-        out_shape=jax.ShapeDtypeStruct((H, W), jnp.uint8),
+        out_shape=_out_struct(grid, H, W),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((BM, W), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[
-            # two slots of (BM + two 8-row halo slabs) for double buffering
-            pltpu.VMEM((2, BM + 16, W), jnp.uint8),
+            # two slots of (BM + two halo slabs) for double buffering
+            pltpu.VMEM((2, BM + 2 * HALO, W), jnp.uint8),
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=interpret,
     )(grid)
 
 
-def make_pallas_stepper(rule: Rule = LIFE, boundary: str = "periodic", interpret: bool = False):
-    """evolve(grid, steps) using the fused kernel per step; jitted with a
-    donated carry so ``evolve.lower`` works for ahead-of-time compilation
-    (the same contract as ``pallas_bitlife.make_pallas_bit_stepper``)."""
+def make_pallas_stepper(
+    rule: Rule = LIFE,
+    boundary: str = "periodic",
+    interpret: bool = False,
+    gens: int = 1,
+    blocks: tuple[int, int] | None = None,
+):
+    """evolve(grid, steps) using the fused kernel, running ``gens``
+    generations per kernel pass (temporal blocking); jitted with a donated
+    carry so ``evolve.lower`` works for ahead-of-time compilation (the same
+    contract as ``pallas_bitlife.make_pallas_bit_stepper``).  ``blocks``
+    overrides the auto-picked (BM, SR) per pass — the autotuner's
+    block-shape knob (a bad override fails at compile and takes the
+    engine's XLA fallback, never a wrong answer)."""
+    from mpi_tpu.utils.segmenting import segmented_evolve
 
-    @functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=0)
-    def evolve(grid: jax.Array, steps: int) -> jax.Array:
-        def body(g, _):
-            return pallas_step(g, rule, boundary, interpret=interpret), None
+    def make_local(k):
+        def local(g):
+            return pallas_step(g, rule, boundary, interpret=interpret,
+                               gens=k, blocks=blocks)
 
-        out, _ = lax.scan(body, grid, None, length=steps)
-        return out
+        return local
 
-    return evolve
+    return segmented_evolve(make_local, gens)
 
 
 def use_pallas(shape, rule: Rule) -> bool:

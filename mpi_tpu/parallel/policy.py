@@ -93,6 +93,7 @@ def choose_comm_policy(
     latency_us: float,
     overlap_requested: bool = False,
     single_device_pallas: bool = False,
+    single_device_pallas_gens: Optional[int] = None,
 ) -> Tuple[int, bool]:
     """(comm_every, overlap) for ``--comm-every auto``.
 
@@ -100,17 +101,24 @@ def choose_comm_policy(
     when the fused radius-1 kernel will serve the run
     (``single_device_pallas`` — the caller has checked the platform gate
     and kernel ``supports()``; VERDICT r3 item 4: the measured winner,
-    not the un-blocked kernel), else (1, overlap_requested): off the
-    fused kernel there is no collective to avoid and no temporal
-    blocking to engage.  Multi-device: K from the latency table, clamped
-    by the engine's halo bounds (K ≤ 16 at radius 1, K·r ≤ 31 beyond)
-    and the fringe budget (K·r ≤ tile_min/8); rules that give birth on 0
-    neighbors cannot run deep halos at all.  ``overlap`` turns on
-    whenever the stitched bands fit the tile (hiding the exchange costs
-    nothing but the fringe recompute that K already budgeted)."""
+    not the un-blocked kernel); ``single_device_pallas_gens`` is the
+    dense analog — the caller-validated temporal-blocking depth of the
+    fused dense stencil kernel (ops/pallas_stencil.py, any radius with
+    gens·r ≤ 16), taken when the SWAR route does not apply.  Else
+    (1, overlap_requested): off the fused kernels there is no collective
+    to avoid and no temporal blocking to engage.  Multi-device: K from
+    the latency table, clamped by the engine's halo bounds (K ≤ 16 at
+    radius 1, K·r ≤ 31 beyond) and the fringe budget (K·r ≤ tile_min/8);
+    rules that give birth on 0 neighbors cannot run deep halos at all.
+    ``overlap`` turns on whenever the stitched bands fit the tile
+    (hiding the exchange costs nothing but the fringe recompute that K
+    already budgeted)."""
     if n_devices <= 1:
         if single_device_pallas and rule.radius == 1 and 0 not in rule.birth:
             return SINGLE_DEVICE_PALLAS_GENS, overlap_requested
+        if (single_device_pallas_gens and single_device_pallas_gens > 1
+                and 0 not in rule.birth):
+            return single_device_pallas_gens, overlap_requested
         return 1, overlap_requested
     r = rule.radius
     if 0 in rule.birth:
@@ -150,6 +158,37 @@ def resolve_auto(
             (config.rows, config.cols), config.rule,
             gens=SINGLE_DEVICE_PALLAS_GENS,
         )
+    dense_gens = None
+    if n == 1 and not single_pallas and 0 not in config.rule.birth:
+        # will the run route to the DENSE engine, and can the fused dense
+        # stencil kernel (ops/pallas_stencil.py) temporally block it?
+        # Mirrors build_engine's routing (plan_pad_width -> packed,
+        # select_ltl_mode -> bit-sliced, else dense) — evaluated at each
+        # candidate depth, since routing itself depends on comm_every.
+        import dataclasses
+
+        from mpi_tpu.backends.tpu import (
+            _pallas_single_device_mode, plan_pad_width, select_ltl_mode,
+        )
+        from mpi_tpu.ops.pallas_stencil import supports as dense_supports
+
+        use, _ = _pallas_single_device_mode()
+        if use:
+            for g in (SINGLE_DEVICE_PALLAS_GENS, 4, 2):
+                if g * config.rule.radius > 16:
+                    continue  # deeper than the kernel's halo slab
+                cfg_g = dataclasses.replace(config, comm_every=g)
+                cols_eff, pad_bits = plan_pad_width(
+                    cfg_g, 1, shard_rows=config.rows)
+                if config.rule.radius == 1 and cols_eff % 32 == 0:
+                    continue  # packed SWAR engine serves this run
+                if select_ltl_mode(cfg_g, 1, 1, cols=cols_eff,
+                                   pad_bits=pad_bits)[0] is not None:
+                    continue  # bit-sliced LtL engine serves this run
+                if dense_supports((config.rows, config.cols),
+                                  config.rule, gens=g):
+                    dense_gens = g
+                    break
     if n > 1 and latency_us is None:
         latency_us = probe_collective_latency_us(mesh)
         import jax
@@ -170,4 +209,5 @@ def resolve_auto(
         latency_us if latency_us is not None else 0.0,
         overlap_requested=config.overlap,
         single_device_pallas=single_pallas,
+        single_device_pallas_gens=dense_gens,
     )

@@ -70,9 +70,24 @@ def _kill_outside_global(x, axes, margins):
     return x
 
 
+def dense_local_pallas_ok(local_shape, rule: Rule, k: int) -> bool:
+    """Can the fused dense stencil kernel (``ops/pallas_stencil.py``) serve
+    a (h, w) local tile's interior at k generations per exchange?  The
+    kernel runs on the *unpadded* tile (lane-aligned width, slab-divisible
+    rows — the alignment contract cannot hold on the ghost-padded shape),
+    so the stitched-band structure supplies the cross-shard edges and
+    needs ≥ 2·k·r rows and columns left over."""
+    from mpi_tpu.ops.pallas_stencil import supports
+
+    h, w = local_shape
+    d = k * rule.radius
+    return h >= 2 * d and w >= 2 * d and supports((h, w), rule, gens=k)
+
+
 def make_sharded_stepper(
     mesh: Mesh, rule: Rule, boundary: str, axes=AXES, gens_per_exchange: int = 1,
-    overlap: bool = False,
+    overlap: bool = False, use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
     """Returns evolve(grid, steps) running shard-parallel over the mesh.
 
@@ -94,6 +109,19 @@ def make_sharded_stepper(
     outside-global fringe cells are re-killed each generation (the same
     discipline as the non-overlap path), masked per band side so a band's
     interior-facing side is never touched.
+
+    ``use_pallas=True``: the tile *interior* runs through the fused dense
+    temporal-blocking kernel (``ops.pallas_stencil.pallas_step`` at
+    ``gens=K``) with dead tile-edge fill — bitwise identical to the XLA
+    trapezoid on the kept region, because both evolve with zeros past the
+    tile and every kept cell's K-generation dependence cone stays inside
+    it — while the stitched edge bands stay on the XLA path (thin,
+    misaligned slices the kernel's DMA contract cannot serve).  One
+    kernel dispatch replaces the K per-generation stencil passes of a
+    segment.  Taken per shard shape (:func:`dense_local_pallas_ok`);
+    tiles the kernel cannot serve fall back to the XLA bodies.
+    ``pallas_interpret`` runs the kernel in interpret mode (CPU-mesh
+    tests).
     """
     K = gens_per_exchange
     r = rule.radius
@@ -137,7 +165,27 @@ def make_sharded_stepper(
                     )
             return padded
 
-        def body_overlap(local):
+        def interior_xla(local):
+            # interior from local data alone: trapezoid over the
+            # zero-padded tile, keeping rows [d, h-d) (full width; the
+            # invalid outer-d columns are replaced by lb/rb in the stitch)
+            d = k * r
+            return evolve_trapezoid(jnp.pad(local, d), k)[d:-d, :]
+
+        def interior_pallas(local):
+            # fused temporal-blocking kernel, dead tile-edge fill == the
+            # zero-pad semantics of interior_xla, so the kept region
+            # matches bit-for-bit (the ≤ d-deep corrupt fringe from the
+            # tile edge lies entirely in the replaced rows/columns)
+            from mpi_tpu.ops.pallas_stencil import pallas_step
+
+            h = local.shape[0]
+            d = k * r
+            return pallas_step(
+                local, rule, "dead", interpret=pallas_interpret, gens=k
+            )[d : h - d, :]
+
+        def body_overlap(local, interior):
             h, w = local.shape
             d = k * r  # ghost/band depth
             padded = exchange_halo(local, d, boundary, axes)  # (h+2d, w+2d)
@@ -146,7 +194,7 @@ def make_sharded_stepper(
             # invalid outer-d columns are replaced by lb/rb below.  (No
             # dead-boundary kill needed: every kept cell is >= d from the
             # tile edge, out of reach of the zero-pad fringe.)
-            q = evolve_trapezoid(jnp.pad(local, d), k)[d:-d, :]
+            q = interior(local)
             # edge bands from the exchanged halo, full cross dimension so
             # corners are exact; band output coord i = input coord i + d.
             # kill_sides: each band's outward + lateral sides can lie
@@ -164,8 +212,12 @@ def make_sharded_stepper(
         @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
         def local_step(local):
             h, w = local.shape
+            if use_pallas and dense_local_pallas_ok((h, w), rule, k):
+                # fused interior + stitched bands: also the overlap
+                # structure, so a requested overlap is inherently honored
+                return body_overlap(local, interior_pallas)
             if overlap and min(h, w) >= 2 * k * r:
-                return body_overlap(local)
+                return body_overlap(local, interior_xla)
             return body_exchange_all(local)
 
         return local_step

@@ -29,6 +29,10 @@ from mpi_tpu.config import ConfigError, GolConfig, apply_plan, validate_mesh
 
 COMM_EVERY_CANDIDATES = (2, 4, 8)
 SPARSE_TILE_CANDIDATES = (32, 64, 128, 256)
+# rectangular Pallas block grid (rows); candidates are screened by the
+# kernels' own alignment/VMEM predicates before being proposed
+PALLAS_BLOCK_SIZES = (512, 256, 128, 64, 32)
+MAX_BLOCK_CANDIDATES = 6   # per (plan-prefix) axis — keeps sweeps bounded
 
 
 @dataclass(frozen=True)
@@ -63,33 +67,98 @@ def _feasible(config: GolConfig, mesh_shape: Tuple[int, int],
     return True
 
 
-def _block_candidates(config: GolConfig,
-                      mesh_shape: Tuple[int, int]) -> Iterator[Candidate]:
-    """Pallas block-shape overrides — only where the fused SWAR kernel
-    actually serves the plan (single device, radius 1, supported shape,
-    real TPU lowering): elsewhere the override is dead weight."""
-    if mesh_shape != (1, 1) or config.rule.radius != 1:
+def _block_candidates(config: GolConfig, mesh_shape: Tuple[int, int],
+                      gens: int = None) -> Iterator[Candidate]:
+    """Pallas block-shape overrides — only where a fused kernel actually
+    serves the plan (single device, supported shape, real TPU lowering):
+    elsewhere the override is dead weight.
+
+    The grid is rectangular (BM × CM over ``PALLAS_BLOCK_SIZES``),
+    screened by the kernel's own alignment/VMEM predicate
+    (``pallas_bitlife.blocks_ok`` — the same screens ``_pick_blocks``
+    applies to its auto-candidates) and capped at
+    ``MAX_BLOCK_CANDIDATES``; the auto-picked shape is excluded (it IS
+    the incumbent).  ``gens`` overrides the temporal-blocking depth the
+    candidates are validated at (the (k, blocks) paired axis — see
+    :func:`candidates`).
+
+    Opcount-pruning soundness (tuner ``should_prune``): a ``blocks``
+    override never changes the *traced* op count — the kernel's interior
+    is opaque to the trace — so a blocks candidate's optimistic bound
+    equals the incumbent's and it can never be wrongly pruned; paired
+    (comm_every, blocks) candidates are traced at their own depth, so
+    their bound is their own.  Radius > 1 dense-routed plans take the
+    dense stencil kernel's (BM, SR) knob instead, screened by its
+    ``_pick_block_rows``/``_pick_sub_rows`` budgets."""
+    if mesh_shape != (1, 1):
         return
     from mpi_tpu.backends.tpu import _pallas_single_device_mode
-    from mpi_tpu.ops.pallas_bitlife import _pick_blocks, supports
 
     use, interpret = _pallas_single_device_mode()
     if not use or interpret:
         return
-    gens = config.comm_every
+    tag = "" if gens is None else f"comm_every={gens},"
+    base = {} if gens is None else {"comm_every": gens}
+    if gens is None:
+        gens = config.comm_every
+    count = 0
+    if config.rule.radius == 1:
+        from mpi_tpu.ops.pallas_bitlife import blocks_ok, _pick_blocks, supports
+
+        if not supports((config.rows, config.cols), config.rule, gens=gens):
+            return
+        H, NW = config.rows, config.cols // 32
+        auto = _pick_blocks(H, NW, gens)
+        if auto is None:
+            return
+        for bm in PALLAS_BLOCK_SIZES:
+            for cm in PALLAS_BLOCK_SIZES:
+                if cm > bm or (bm, cm) == auto:
+                    continue
+                if not blocks_ok(H, NW, bm, cm, gens):
+                    continue
+                yield Candidate({**base, "blocks": [bm, cm]},
+                                f"{tag}blocks={bm}x{cm}")
+                count += 1
+                if count >= MAX_BLOCK_CANDIDATES:
+                    return
+        return
+    # radius > 1: the dense fused stencil kernel's (BM, SR) plane —
+    # only when the dense route will actually dispatch it (bit-sliced
+    # modes own word-aligned shapes their kernel serves; blocks are dead
+    # weight there)
+    import dataclasses
+
+    from mpi_tpu.backends.tpu import plan_pad_width, select_ltl_mode
+    from mpi_tpu.ops.pallas_stencil import (
+        _halo_rows, _pick_block_rows, _pick_sub_rows, supports,
+    )
+
     if not supports((config.rows, config.cols), config.rule, gens=gens):
         return
-    H, NW = config.rows, config.cols // 32
-    picked = _pick_blocks(H, NW, gens)
-    if picked is None:
+    cfg_g = (dataclasses.replace(config, comm_every=gens)
+             if gens != config.comm_every else config)
+    cols_eff, pad_bits = plan_pad_width(cfg_g, 1, shard_rows=config.rows)
+    if select_ltl_mode(cfg_g, 1, 1, cols=cols_eff,
+                       pad_bits=pad_bits)[0] is not None:
         return
-    BM, _ = picked
-    seen = {BM}
-    for bm in (BM // 2, BM * 2):
-        if bm and bm not in seen and H % bm == 0:
-            seen.add(bm)
-            yield Candidate({"blocks": [bm, min(bm, 8)]},
-                            f"blocks={bm}x{min(bm, 8)}")
+    H, W = config.rows, config.cols
+    halo = _halo_rows(gens, config.rule.radius)
+    auto_bm = _pick_block_rows(H, W, config.rule.radius, gens)
+    auto = (auto_bm, _pick_sub_rows(auto_bm, W))
+    for bm in PALLAS_BLOCK_SIZES:
+        if H % bm or (halo > 8 and bm % halo):
+            continue
+        if (bm + 2 * halo) * W > (1 << 21):  # _pick_block_rows budget
+            continue
+        sr = _pick_sub_rows(bm, W)
+        if (bm, sr) == auto:
+            continue
+        yield Candidate({**base, "blocks": [bm, sr]},
+                        f"{tag}blocks={bm}x{sr}")
+        count += 1
+        if count >= MAX_BLOCK_CANDIDATES:
+            return
 
 
 def candidates(config: GolConfig, mesh_shape: Tuple[int, int],
@@ -101,17 +170,28 @@ def candidates(config: GolConfig, mesh_shape: Tuple[int, int],
     out: List[Candidate] = [Candidate()]
     if config.backend != "tpu":
         return out
+    deepest_k = None
     if config.comm_every == 1:
         for k in COMM_EVERY_CANDIDATES:
             plan = {"comm_every": k}
             if _feasible(config, mesh_shape, plan):
                 out.append(Candidate(plan, f"comm_every={k}"))
+                deepest_k = k
     if config.sparse_tile == 0 and mesh_shape == (1, 1):
         for T in SPARSE_TILE_CANDIDATES:
             plan = {"sparse_tile": T}
             if _feasible(config, mesh_shape, plan):
                 out.append(Candidate(plan, f"sparse_tile={T}"))
     out.extend(_block_candidates(config, mesh_shape))
+    if deepest_k is not None:
+        # the (k, blocks) plane of the fused temporal-blocking kernels:
+        # block shapes re-validated at the deepest feasible depth (VMEM
+        # budgets shrink with gens, so depth-1 winners can be infeasible
+        # there and vice versa)
+        out.extend(
+            c for c in _block_candidates(config, mesh_shape, gens=deepest_k)
+            if _feasible(config, mesh_shape, c.plan)
+        )
     if include_batch:
         for B in (2, 4, 8):
             out.append(Candidate({"batch": B}, f"batch={B}"))

@@ -1,0 +1,220 @@
+"""Fused dense temporal-blocking kernel (ISSUE 17): k generations of the
+stencil in ONE ``pallas_call`` must be bit-identical to the
+per-generation chain AND the serial numpy oracle, across rule families
+(B3/S23, LtL r=2, bosco r=5) x boundaries x k, at three levels:
+
+* kernel — ``pallas_step(gens=k)`` vs k chained ``gens=1`` calls vs
+  ``evolve_np`` on a 1x1 "mesh" (single tile);
+* sharded interior — ``make_sharded_stepper(use_pallas=True)`` runs the
+  fused kernel per shard on the virtual CPU meshes while halo exchange
+  and the stitched k·r-deep edge bands stay on XLA;
+* engine — ``build_engine`` routes a single-device radius>1
+  ``comm_every=K`` config onto the fused kernel when the bit-sliced
+  engine's lane contract fails, and the result matches the
+  ``comm_every=1`` engine bit-for-bit.
+
+Plus the overlap identity: ``overlap=True`` (interior from local data
+while the ppermute is in flight, bands stitched after) must be a pure
+schedule change — same bits as ``overlap=False``.
+"""
+
+import dataclasses
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.models.rules import BOSCO, LIFE, Rule
+from mpi_tpu.ops.pallas_stencil import pallas_step, supports
+from mpi_tpu.parallel.mesh import make_mesh
+from mpi_tpu.parallel.step import (
+    dense_local_pallas_ok,
+    grid_sharding,
+    make_sharded_stepper,
+)
+from mpi_tpu.utils.hashinit import init_tile_np
+
+R2 = Rule("r2fd", frozenset(range(8, 13)), frozenset(range(9, 15)), radius=2)
+RULES = {"life": LIFE, "r2": R2, "bosco": BOSCO}
+
+# k sweep clamped by the kernel's halo slab (gens * radius <= 16):
+# life all of {1,2,4,8}, r2 all, bosco {1,2}
+KCASES = [(name, k) for name, rule in RULES.items()
+          for k in (1, 2, 4, 8) if k * rule.radius <= 16]
+KIDS = [f"{name}-k{k}" for name, k in KCASES]
+
+
+# -- kernel level ---------------------------------------------------------
+
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+@pytest.mark.parametrize("rname,k", KCASES, ids=KIDS)
+def test_fused_kernel_parity(rname, k, boundary):
+    rule = RULES[rname]
+    H, W = 32, 128
+    assert supports((H, W), rule, gens=k)
+    g0 = init_tile_np(H, W, seed=41)
+    fused = np.asarray(
+        pallas_step(jnp.asarray(g0), rule, boundary, interpret=True, gens=k))
+    ref = evolve_np(g0, k, rule, boundary)
+    np.testing.assert_array_equal(fused, ref)
+    # the per-generation chain of the same kernel: bit-identical
+    g = jnp.asarray(g0)
+    for _ in range(k):
+        g = pallas_step(g, rule, boundary, interpret=True, gens=1)
+    np.testing.assert_array_equal(fused, np.asarray(g))
+
+
+def test_fused_kernel_rejects_birth_on_zero():
+    # dead fringe beyond the tile would ignite under B0 rules — the
+    # kernel must refuse temporal blocking rather than corrupt
+    b0 = Rule("b0", frozenset({0, 3}), frozenset({2, 3}), radius=1)
+    with pytest.raises(ValueError, match="birth"):
+        pallas_step(jnp.zeros((32, 128), jnp.uint8), b0, "periodic",
+                    interpret=True, gens=2)
+
+
+def test_dense_local_pallas_ok_predicate():
+    # the stepper dispatch and the backend's used_pallas prediction share
+    # this predicate — pin its shapes
+    assert dense_local_pallas_ok((32, 128), R2, 4)
+    assert dense_local_pallas_ok((32, 128), R2, 8)   # h == 2*K*r boundary
+    assert not dense_local_pallas_ok((30, 128), R2, 8)  # h < 2*K*r
+    assert not dense_local_pallas_ok((32, 64), R2, 4)   # lane misaligned
+    assert not dense_local_pallas_ok((32, 128), R2, 16)  # gens*r > halo
+    assert dense_local_pallas_ok((32, 128), BOSCO, 2)
+    assert not dense_local_pallas_ok((32, 128), BOSCO, 4)
+
+
+# -- sharded interior -----------------------------------------------------
+
+# (mesh_shape) -> (rows, cols) giving 32x128 shards (128-lane aligned,
+# deep enough for every k below)
+GRIDS = {(2, 4): (64, 512), (1, 8): (32, 1024)}
+SHARD_CASES = [("life", 4), ("r2", 4), ("bosco", 2)]
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (1, 8)],
+                         ids=["2x4", "1x8"])
+@pytest.mark.parametrize("rname,k", SHARD_CASES,
+                         ids=[f"{n}-k{k}" for n, k in SHARD_CASES])
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_fused_sharded_parity(mesh_shape, rname, k, boundary):
+    rule = RULES[rname]
+    mesh = make_mesh(mesh_shape)
+    R, C = GRIDS[mesh_shape]
+    mi, mj = mesh_shape
+    assert dense_local_pallas_ok((R // mi, C // mj), rule, k)
+    g0 = init_tile_np(R, C, seed=43)
+    ev = make_sharded_stepper(mesh, rule, boundary, gens_per_exchange=k,
+                              use_pallas=True, pallas_interpret=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    steps = k + 1  # one full K-segment plus a remainder segment
+    out = np.asarray(jax.device_get(ev(g, steps)))
+    ref = evolve_np(g0, steps, rule, boundary)
+    np.testing.assert_array_equal(out, ref)
+    # the pure-XLA deep-halo path must agree bit-for-bit
+    ev_xla = make_sharded_stepper(mesh, rule, boundary, gens_per_exchange=k)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    np.testing.assert_array_equal(out, np.asarray(jax.device_get(
+        ev_xla(g, steps))))
+
+
+def _spy_on(monkeypatch, module, name):
+    calls = []
+    mod = importlib.import_module(module)
+    real = getattr(mod, name)
+
+    def wrapper(*args, **kwargs):
+        calls.append((args, kwargs))
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(mod, name, wrapper)
+    return calls
+
+
+def test_fused_dense_dispatch_takes_kernel(monkeypatch):
+    calls = _spy_on(monkeypatch, "mpi_tpu.ops.pallas_stencil", "pallas_step")
+    mesh = make_mesh((2, 4))
+    g0 = init_tile_np(64, 512, seed=47)
+    ev = make_sharded_stepper(mesh, R2, "periodic", gens_per_exchange=4,
+                              use_pallas=True, pallas_interpret=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    jax.block_until_ready(ev(g, 4))
+    assert calls, "fused dispatch must route the interior through the kernel"
+    assert all(kw.get("gens") == 4 for _, kw in calls)
+
+
+def test_fused_dense_nonaligned_shard_falls_back(monkeypatch):
+    # 64-cell-wide shards miss the kernel's 128-lane alignment:
+    # use_pallas=True must silently take the XLA body and still match
+    calls = _spy_on(monkeypatch, "mpi_tpu.ops.pallas_stencil", "pallas_step")
+    mesh = make_mesh((2, 4))
+    R, C = 64, 256
+    assert not dense_local_pallas_ok((R // 2, C // 4), R2, 2)
+    g0 = init_tile_np(R, C, seed=53)
+    ev = make_sharded_stepper(mesh, R2, "dead", gens_per_exchange=2,
+                              use_pallas=True, pallas_interpret=True)
+    g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+    out = np.asarray(jax.device_get(ev(g, 2)))
+    np.testing.assert_array_equal(out, evolve_np(g0, 2, R2, "dead"))
+    assert not calls
+
+
+# -- overlap identity -----------------------------------------------------
+
+@pytest.mark.parametrize("use_pallas", [False, True],
+                         ids=["xla", "pallas"])
+@pytest.mark.parametrize("boundary", ["periodic", "dead"])
+def test_overlap_identity(use_pallas, boundary):
+    # overlap=True reorders the schedule (interior before the collective
+    # lands, k·r-deep bands stitched after) but must not change one bit
+    mesh = make_mesh((2, 4))
+    R, C = 64, 512
+    k = 4
+    g0 = init_tile_np(R, C, seed=59)
+    outs = {}
+    for overlap in (False, True):
+        ev = make_sharded_stepper(
+            mesh, R2, boundary, gens_per_exchange=k, overlap=overlap,
+            use_pallas=use_pallas, pallas_interpret=use_pallas)
+        g = jax.device_put(jnp.asarray(g0), grid_sharding(mesh))
+        outs[overlap] = np.asarray(jax.device_get(ev(g, k + 1)))
+    np.testing.assert_array_equal(outs[False], outs[True])
+    np.testing.assert_array_equal(
+        outs[True], evolve_np(g0, k + 1, R2, boundary))
+
+
+# -- engine level ---------------------------------------------------------
+
+def _r2_cfg(comm_every):
+    from mpi_tpu.config import GolConfig
+    from mpi_tpu.models.rules import rule_from_name
+
+    return GolConfig(rows=32, cols=128, steps=0, backend="tpu",
+                     mesh_shape=(1, 1), comm_every=comm_every,
+                     rule=rule_from_name("R2,B8-12,S9-14"))
+
+
+def test_engine_single_device_fused_dense(monkeypatch):
+    # 128 cols is 128-lane aligned for the dense kernel but far below the
+    # bit-sliced LtL kernel's lane contract, so a comm_every=4 run must
+    # land on the fused dense kernel — and match both the oracle and the
+    # comm_every=1 engine
+    import mpi_tpu.backends.tpu as tpu
+
+    monkeypatch.setattr(tpu, "_pallas_single_device_mode",
+                        lambda: (True, True))
+    eng = tpu.build_engine(_r2_cfg(4))
+    assert eng._used_pallas, eng.notes
+    g = eng.init_grid(seed=7)
+    out = np.asarray(eng.fetch(eng.step(g, 9)))  # segments 4 + 4 + 1
+    rule = _r2_cfg(4).rule
+    ref = evolve_np(init_tile_np(32, 128, seed=7), 9, rule, "periodic")
+    np.testing.assert_array_equal(out, ref)
+    eng1 = tpu.build_engine(_r2_cfg(1))
+    g1 = eng1.init_grid(seed=7)
+    np.testing.assert_array_equal(
+        out, np.asarray(eng1.fetch(eng1.step(g1, 9))))

@@ -206,8 +206,10 @@ def test_canon_text_has_no_addresses_or_paths(fast_report):
 
 
 def test_sparse_salt_scrubbed(fast_report):
-    from mpi_tpu.ops.activity import cache_salt
+    from mpi_tpu.ops.activity import _cache_optout_active, cache_salt
 
+    if not _cache_optout_active():
+        pytest.skip("jaxlib > 0.4.37: cache opt-out (and its salt) is off")
     by_id = {tc.cell.id: tc for tc in fast_report.traced}
     text = by_id["sparse_1x1"].canon.text
     assert "SALT" in text

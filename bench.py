@@ -983,6 +983,100 @@ def serve_bench_recovery() -> None:
     print(json.dumps(out))
 
 
+def serve_bench_durability(n: int = 4096, steps: int = 6) -> None:
+    """`python bench.py --serve-durability`: A/B of the two persistence
+    forms on a quiescent 4096^2 board (ISSUE 18).
+
+    Arm A is the PR-3 baseline — full-record rewrite on every committed
+    step (`state_journal=False`, `checkpoint_every=1`).  Arm B is the
+    incremental journal (same cadence; compaction disabled so the arm
+    measures pure journal appends).  The board is a still-life block
+    field, so arm B's entries are empty deltas — the shape a mostly
+    quiescent production board persists.  Gates: (1) the journal moves
+    >= 3x fewer bytes per committed step than full rewrites, (2) the
+    journal arm's per-step wall is within 2% of (in practice, below)
+    the full-rewrite baseline, (3) restore over the journal replays to
+    a board bit-identical to the live one.  Output carries the
+    bench_gate envelope keys (`metric`/`value`/`platform`/`size`/
+    `gens`/`plan="journal"`) so the banked record forms its own
+    envelope row, keyed apart from the step-throughput ladders by the
+    plan dimension.  One JSON line, errors in the "error" field.
+    """
+    out = {"bench": "serve_durability", "ok": False}
+    try:
+        import tempfile
+
+        import numpy as np
+
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.session import SessionManager
+
+        N = n
+        spec = {"rows": N, "cols": N, "backend": "serial", "seed": 1}
+        # still-life block field: 2x2 blocks on a 64-cell pitch — every
+        # generation is bit-identical to the last, so journal entries
+        # are empty deltas while full rewrites still carry the board
+        board = np.zeros((N, N), dtype=np.uint8)
+        board[::64, ::64] = board[::64, 1::64] = 1
+        board[1::64, ::64] = board[1::64, 1::64] = 1
+
+        def run(journal):
+            state_dir = tempfile.mkdtemp(prefix="mpi_tpu_bench_dur_")
+            mgr = SessionManager(EngineCache(max_size=4),
+                                 state_dir=state_dir, checkpoint_every=1,
+                                 state_journal=journal,
+                                 journal_max_bytes=1 << 40)
+            sid = mgr.create(dict(spec))["id"]
+            mgr.write_board(sid, board)
+            mgr.step(sid, 1)                   # warm the serial path
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                mgr.step(sid, 1)
+            wall = time.perf_counter() - t0
+            st = mgr.stats()["recovery"]
+            return mgr, sid, state_dir, wall, st
+
+        _, _, _, full_wall, full_st = run(journal=False)
+        mgr_j, sid, jdir, jrn_wall, jrn_st = run(journal=True)
+
+        # bytes per committed step, measured after the write_board
+        # anchor: full arm counts record envelopes, journal arm counts
+        # appended entries (its own record writes happen only at
+        # create/board-write, before the timed window)
+        full_bps = full_st["bytes_full"] / max(1, full_st["writes"] - 2)
+        jrn_bps = jrn_st["bytes_delta"] / max(1, jrn_st["journal_appends"])
+        bytes_gate = jrn_bps * 3 <= full_bps
+        overhead_gate = jrn_wall <= full_wall * 1.02
+
+        live = mgr_j.snapshot(sid)["grid"]
+        mgr2 = SessionManager(EngineCache(max_size=4), state_dir=jdir)
+        parity = (mgr2.restored_sessions >= 1
+                  and mgr2.snapshot(sid)["grid"] == live)
+
+        out.update(
+            ok=bool(bytes_gate and overhead_gate and parity),
+            rows=N, cols=N, steps=steps,
+            metric="persisted_steps_per_sec_journal",
+            value=round(steps / jrn_wall, 3),
+            unit="steps/s",
+            platform="cpu",
+            size=N, gens=steps, plan="journal",
+            full_wall_s=round(full_wall, 4),
+            journal_wall_s=round(jrn_wall, 4),
+            full_bytes_per_step=round(full_bps, 1),
+            journal_bytes_per_step=round(jrn_bps, 1),
+            bytes_ratio=round(full_bps / max(jrn_bps, 1e-9), 1),
+            journal_appends=jrn_st["journal_appends"],
+            compactions=jrn_st["compactions"],
+            gate_bytes_ok=bytes_gate,
+            gate_overhead_ok=overhead_gate,
+            gate_restore_parity_ok=bool(parity),
+        )
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 def serve_bench_obs() -> None:
     """`python bench.py --serve-obs`: the instrumentation-overhead gate.
 
@@ -1991,6 +2085,8 @@ MODES = {
     "--serve-batched": lambda argv: serve_bench_batched(),
     "--serve-async": lambda argv: serve_bench_async(),
     "--serve-recovery": lambda argv: serve_bench_recovery(),
+    "--serve-durability": lambda argv: serve_bench_durability(
+        *(int(a) for a in argv[:2])),
     "--serve-obs": lambda argv: serve_bench_obs(),
     "--serve-admission": lambda argv: serve_bench_admission(),
     "--serve-wire": lambda argv: serve_bench_wire(),

@@ -51,11 +51,26 @@ def _fn_index(tree: ast.AST) -> Dict[str, ast.AST]:
     return out
 
 
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Fold a Name/Attribute chain to "a.b.c" without ast.unparse —
+    the hot path (every Call in the tree goes through here); non-chain
+    expressions (calls, subscripts) return None and never match."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
 def _mentions(fn: ast.AST, needles) -> bool:
     for node in ast.walk(fn):
         if isinstance(node, ast.Call):
-            d = _dump(node.func)
-            if any(d == n or d.endswith("." + n) for n in needles):
+            d = _dotted(node.func)
+            if d is not None \
+                    and any(d == n or d.endswith("." + n) for n in needles):
                 return True
         elif isinstance(node, ast.Name) and node.id in needles:
             return True
@@ -112,12 +127,14 @@ def _hop_sites(fn: ast.AST):
         if isinstance(node.func, ast.Attribute) and node.func.attr == "submit":
             if node.args:
                 yield node, node.args[0]
-        else:
-            d = _dump(node.func)
-            if d in ("Thread", "threading.Thread"):
-                for kw in node.keywords:
-                    if kw.arg == "target":
-                        yield node, kw.value
+        elif (isinstance(node.func, ast.Name) and node.func.id == "Thread") \
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Thread"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "threading"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    yield node, kw.value
 
 
 def check(sf: SourceFile) -> List[Finding]:
@@ -126,6 +143,9 @@ def check(sf: SourceFile) -> List[Finding]:
     for fn in ast.walk(sf.tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
+        sites = list(_hop_sites(fn))
+        if not sites:
+            continue                    # hop-free fn: skip the scans
         # copy_context anywhere in the launching function blesses its
         # hops: the watchdog builds ctx once and runs everything in it
         launcher_wraps = _mentions(fn, ("copy_context", "ctx.run"))
@@ -135,7 +155,7 @@ def check(sf: SourceFile) -> List[Finding]:
                     and isinstance(node.targets[0], ast.Name) \
                     and isinstance(node.value, ast.Lambda):
                 local_lambdas[node.targets[0].id] = node.value
-        for call, callee_expr in _hop_sites(fn):
+        for call, callee_expr in sites:
             if launcher_wraps:
                 continue
             callee = _resolve_callee(callee_expr, index, local_lambdas)

@@ -113,10 +113,11 @@ class _Guard:
     lock: str       # lock attribute name: "lock", "_cv", ...
 
 
-def _guards_in(fn: ast.AST) -> List[_Guard]:
+def _guards_in(fn: ast.AST, nodes: Optional[List[ast.AST]] = None) \
+        -> List[_Guard]:
     guards: List[_Guard] = []
     acquires: List[Tuple[int, str, str]] = []   # (line, base, lock)
-    for node in ast.walk(fn):
+    for node in (ast.walk(fn) if nodes is None else nodes):
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 ce = item.context_expr
@@ -170,22 +171,20 @@ def _iter_method_scopes(sf: SourceFile):
     yield from rec(sf.tree, None)
 
 
-def _check_attr_accesses(sf: SourceFile) -> List[Finding]:
+def _check_attr_accesses(sf: SourceFile, scopes: List[tuple]) -> List[Finding]:
     findings: List[Finding] = []
     defined_here = {n.name for n in ast.walk(sf.tree)
                     if isinstance(n, ast.ClassDef)}
     alias_ok = sf.rel in ALIAS_MODULES or "lint_fixtures" in sf.rel
 
-    for cls, fn in _iter_method_scopes(sf):
+    for cls, fn, nodes, guards in scopes:
         if fn.name == "__init__":
             continue
-        guards = _guards_in(fn)
-        for node in ast.walk(fn):
+        for node in nodes:
             if not isinstance(node, ast.Attribute):
                 continue
             attr = node.attr
             base = node.value
-            base_d = _dump(base)
             # self.<attr> inside the owning class
             if isinstance(base, ast.Name) and base.id == "self" \
                     and cls in MANIFEST and cls in defined_here \
@@ -199,9 +198,12 @@ def _check_attr_accesses(sf: SourceFile) -> List[Finding]:
                         f"self.{lock} (declared shared in the lock "
                         f"manifest)"))
                 continue
-            # <...>.<alias>.<attr> chains in serve modules
+            # <...>.<alias>.<attr> chains in serve modules (the unparse
+            # is deferred here — most files and most attributes never
+            # reach the alias path, and it dominates the lint budget)
             if not alias_ok:
                 continue
+            base_d = _dump(base)
             tail = base_d.rsplit(".", 1)[-1]
             for cname, spec in MANIFEST.items():
                 if tail in spec.aliases and attr in spec.guarded:
@@ -219,13 +221,13 @@ def _check_attr_accesses(sf: SourceFile) -> List[Finding]:
     return findings
 
 
-def _check_multi_lock(sf: SourceFile) -> List[Finding]:
+def _check_multi_lock(sf: SourceFile, scopes: List[tuple]) -> List[Finding]:
     """Acquire loops must sort by .id first; no session lock under _cv."""
     findings: List[Finding] = []
-    for _cls, fn in _iter_method_scopes(sf):
+    for _cls, fn, nodes, fn_guards in scopes:
         # (a) for-loop acquiring .lock on elements of an iterable
         sorted_names: Set[str] = set()
-        for node in ast.walk(fn):
+        for node in nodes:
             # name.sort(key=...".id"...) or name = sorted(..., key=...".id"...)
             if isinstance(node, ast.Call):
                 if isinstance(node.func, ast.Attribute) \
@@ -242,7 +244,7 @@ def _check_multi_lock(sf: SourceFile) -> List[Finding]:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             sorted_names.add(t.id)
-        for node in ast.walk(fn):
+        for node in nodes:
             if not isinstance(node, ast.For):
                 continue
             acquires_locks = any(
@@ -269,8 +271,8 @@ def _check_multi_lock(sf: SourceFile) -> List[Finding]:
                 ".id as in MicroBatcher._run_chunk)"))
         # (b) session lock taken while holding _cv: lock order is
         # session.lock -> _cv, never reversed
-        cv_guards = [g for g in _guards_in(fn) if g.lock == "_cv"]
-        for node in ast.walk(fn):
+        cv_guards = [g for g in fn_guards if g.lock == "_cv"]
+        for node in nodes:
             grabbing = None
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
@@ -298,7 +300,13 @@ def _check_multi_lock(sf: SourceFile) -> List[Finding]:
 
 
 def check(sf: SourceFile) -> List[Finding]:
-    return _check_attr_accesses(sf) + _check_multi_lock(sf)
+    # one walk + one guard scan per scope, shared by both checkers —
+    # re-walking every def for every sub-check dominated the lint budget
+    scopes = []
+    for cls, fn in _iter_method_scopes(sf):
+        nodes = list(ast.walk(fn))
+        scopes.append((cls, fn, nodes, _guards_in(fn, nodes)))
+    return _check_attr_accesses(sf, scopes) + _check_multi_lock(sf, scopes)
 
 
 RULE = Rule(

@@ -76,7 +76,7 @@ class _PeerState:
 
     __slots__ = ("addr", "tag", "last_seen", "last_seq", "sessions",
                  "ledger", "slo", "tenants", "breakers_open", "added_at",
-                 "inc", "suspect")
+                 "inc", "suspect", "persist_degraded")
 
     def __init__(self, addr: str):
         self.addr = addr
@@ -91,6 +91,10 @@ class _PeerState:
         self.added_at = time.monotonic()            # suspect clock baseline
         self.inc: Optional[float] = None            # sender incarnation
         self.suspect = False
+        # the peer's persistence-degraded bit (ISSUE 18): while True,
+        # its recent checkpoints are known-unwritten, so failover must
+        # not adopt its sessions from the shared state dir
+        self.persist_degraded = False
 
 
 class ClusterNode:
@@ -385,23 +389,46 @@ class ClusterNode:
                 "last_seen": (ps.last_seen if ps.last_seen is not None
                               else ps.added_at),
                 "sessions": ps.sessions,
+                "persist_degraded": ps.persist_degraded,
             }
             self._rebuild_ring_locked()
             self.membership_changes["confirm_dead"] += 1
-        adopted, lost = self._failover(addr, ps.tag, epoch)
+        adopted, lost = self._failover(addr, ps.tag, epoch,
+                                       degraded=ps.persist_degraded)
         self.event("membership_change", kind="confirm_dead", member=addr,
                     epoch=epoch, adopted=adopted, lost=lost)
 
-    def _failover(self, addr: str, tag: str, epoch: int):
+    def _failover(self, addr: str, tag: str, epoch: int,
+                  degraded: bool = False):
         """Adopt the dead node's orphaned sessions that the post-death
         ring assigns to THIS node, from the shared state dir, via the
         deterministic replay path.  Routes re-record at the death epoch
-        so they beat the dead owner's stale entries in every merge."""
+        so they beat the dead owner's stale entries in every merge.
+        ``degraded``: the dead peer's last gossiped persistence bit —
+        True means its recent checkpoints are known-unwritten, so
+        adopting its records would silently resurrect stale boards;
+        the sessions are counted lost instead (a loud, honest outcome
+        the operator can act on: scrub → repair → adopt)."""
         mgr = self.manager
         store = getattr(mgr, "store", None)
         adopted = lost = 0
         candidates = {sid for sid, node in self.table.snapshot().items()
                       if node == addr}
+        if degraded:
+            n = len(candidates)
+            if store is not None:
+                suffix = f"-{tag}"
+                n = len(candidates | {sid for sid in store.list_ids()
+                                      if sid.endswith(suffix)})
+            if n:
+                print(f"warning: not adopting {n} session(s) from dead "
+                      f"peer {addr}: its persistence was degraded "
+                      f"(checkpoints known-unwritten); run tools/scrub.py "
+                      f"on the state dir, then POST /cluster/adopt",
+                      file=sys.stderr)
+            with self._lock:
+                self.failover_lost += n
+            return 0, n
         if store is not None:
             # records the dead node persisted but whose routes never
             # reached us: the sid carries the ALLOCATING front's tag, so
@@ -481,7 +508,11 @@ class ClusterNode:
                 self.net_fault("proxy", succ)
                 reply = send_adopt(succ, self.id, batch,
                                    timeout_s=self.proxy_timeout_s)
-            except (PeerUnreachable, KeyError) as e:
+            except (PeerUnreachable, KeyError, OSError) as e:
+                # OSError covers the drain checkpoint failing to land
+                # (injected io fault, degraded store): the batch stays
+                # local and served — handing it off would lose every
+                # generation since the last durable record
                 errors[succ] = str(e)
                 continue
             accepted = [sid for sid in reply.get("adopted") or []
@@ -543,6 +574,12 @@ class ClusterNode:
             "tenants": (mgr.admission.window_snapshot()
                         if getattr(mgr, "admission", None) is not None
                         else None),
+            # the degraded bit (ISSUE 18): True while this node's state
+            # dir is refusing writes — peers must not failover-adopt
+            # from records we may not have written
+            "persist_degraded": bool(
+                getattr(mgr, "store", None) is not None
+                and mgr.store.is_degraded()),
             "routes": self.table.snapshot_entries(),
         }
 
@@ -592,6 +629,7 @@ class ClusterNode:
             ps.tenants = tenants if isinstance(tenants, dict) else None
             ps.breakers_open = [str(b) for b in
                                 (digest.get("breakers_open") or [])]
+            ps.persist_degraded = bool(digest.get("persist_degraded"))
             breakers = list(ps.breakers_open)
             self.gossip_received += 1
         self.manager.cache.set_remote_open(addr, breakers,

@@ -389,6 +389,35 @@ class Obs:
                    "per plan signature",
                    _roofline_efficiency)
 
+        # -- durable state plane (ISSUE 18): scrape-time readouts of the
+        # StateStore's authoritative counters and state machine.  The
+        # families are always present — a server without --state-dir
+        # scrapes zeros/closed rather than dropping them, so dashboards
+        # and the required-family gate see one stable schema.
+        store = getattr(manager, "store", None)
+        m.counter_fn(
+            "mpi_tpu_checkpoint_bytes_total",
+            "Durable bytes written, by form (full record envelopes "
+            "vs appended journal entries)",
+            lambda: [({"kind": "full"}, store.bytes_full if store else 0),
+                     ({"kind": "delta"},
+                      store.bytes_delta if store else 0)])
+        m.counter_fn(
+            "mpi_tpu_state_records_corrupt_total",
+            "Persisted records quarantined for failing CRC/envelope "
+            "validation at restore or adoption",
+            lambda: store.corrupt_records if store else 0)
+        m.gauge_fn(
+            "mpi_tpu_persistence_state",
+            "Persistence state machine: 0 closed (healthy), "
+            "1 recovering (flushing backlog), 2 degraded",
+            lambda: ({"closed": 0, "recovering": 1, "degraded": 2}
+                     [store.persistence_state()["state"]] if store else 0))
+        m.counter_fn(
+            "mpi_tpu_journal_compactions_total",
+            "Session journals compacted into a full record write",
+            lambda: store.compactions if store else 0)
+
     # -- export ----------------------------------------------------------
 
     def render_metrics(self, openmetrics: bool = False) -> str:

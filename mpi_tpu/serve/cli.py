@@ -73,6 +73,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=64,
                    help="generations between packed grid snapshots in the "
                    "session record (bounds replay length on restore)")
+    p.add_argument("--state-degrade",
+                   choices=("continue", "readonly", "shed"),
+                   default="continue",
+                   help="policy while persistence is degraded (the state "
+                   "dir stopped taking writes): 'continue' keeps serving "
+                   "and re-checkpoints when the disk heals, 'readonly' "
+                   "refuses mutating verbs with 503+Retry-After, 'shed' "
+                   "refuses all session verbs so a balancer drains the "
+                   "node")
+    p.add_argument("--no-state-journal", action="store_true",
+                   help="disable the per-session append-only journal and "
+                   "rewrite the full record every committed step (the "
+                   "pre-v2 behavior; costs full-record bytes per step)")
+    p.add_argument("--journal-max-bytes", type=int, default=1 << 20,
+                   help="journal size that triggers compaction into a "
+                   "full record write (default 1 MiB)")
+    p.add_argument("--journal-max-age-s", type=float, default=300.0,
+                   help="journal age that triggers compaction (bounds "
+                   "replay work after a crash; default 300)")
+    p.add_argument("--state-keep", type=int, default=2,
+                   help="last-good ancestor records kept per session "
+                   "(<sid>.json.1..N; restore falls back down this chain "
+                   "when the head is corrupt; default 2)")
     p.add_argument("--request-timeout-s", type=float, default=30.0,
                    help="time budget per request; a hung dispatch becomes "
                    "a structured 503 with the session intact "
@@ -233,6 +256,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             ticket_ttl_s=args.ticket_ttl_s,
             state_dir=args.state_dir,
             checkpoint_every=args.checkpoint_every,
+            state_degrade=args.state_degrade,
+            state_journal=not args.no_state_journal,
+            journal_max_bytes=args.journal_max_bytes,
+            journal_max_age_s=args.journal_max_age_s,
+            state_keep=args.state_keep,
             request_timeout_s=args.request_timeout_s,
             step_retries=args.step_retries,
             retry_backoff_s=args.retry_backoff_ms / 1e3,

@@ -30,24 +30,39 @@ the same site (:meth:`FaultInjector.inbound_cut`) while the clause
 still covers the next outbound ordinal — a deterministic, symmetric
 network split that heals exactly when the clause range is spent.
 
+The storage plane (``serve/recovery.py``) hooks the same plans at its
+single IO choke point, :meth:`StateStore._io`: ``io-write`` (one
+buffered write of a record envelope or journal entry), ``io-fsync``
+(the flush+fsync making it durable), and ``io-replace`` (the atomic
+rename publishing a record).  IO sites get IO modes — ``raise`` fails
+the call with ``EIO``, ``enospc`` fails it with ``ENOSPC`` (the
+full-disk path), ``delay`` sleeps then proceeds, and ``torn:frac``
+makes the write stop after ``frac`` of its bytes *and actually flushes
+the torn prefix to disk* before failing — the exact on-disk shape a
+crash mid-write leaves, which is what the CRC envelopes and journal
+tail-truncation exist to survive.
+
 Spec grammar (comma-separated clauses; a leading ``seed=N`` clause
 seeds the probabilistic selector)::
 
     SPEC   := [ 'seed=' int ',' ] clause ( ',' clause )*
-    clause := site ':' sel ':' mode [ ':' seconds ]
+    clause := site ':' sel ':' mode [ ':' arg ]
     site   := 'step' | 'batched' | 'any' | 'gossip' | 'proxy'
+            | 'io-write' | 'io-fsync' | 'io-replace'
     sel    := N | N'+' | N'-'M | '*' | 'p'FLOAT
     mode   := 'raise' | 'hang' | 'delay'          (engine sites)
             | 'drop' | 'delay' | 'partition'      (network sites)
+            | 'raise' | 'torn' | 'enospc' | 'delay'   (io sites)
 
 ``sel`` counts dispatches at that site from 1 (``any`` counts both
-engine sites together; network sites each count alone): ``3`` fires on
-exactly the 3rd dispatch, ``3+`` from the 3rd on, ``2-4`` on the 2nd
-through 4th, ``*`` on every one, and ``p0.25`` on each with probability
-0.25 drawn from a ``random.Random`` seeded by the plan's ``seed=``
-clause (default 0) — same seed, same dispatch order, same faults, every
-run.  ``seconds`` defaults to 30 for ``hang`` and 0.05 for ``delay``;
-``raise``, ``drop``, and ``partition`` ignore it.
+engine sites together; network and io sites each count alone): ``3``
+fires on exactly the 3rd dispatch, ``3+`` from the 3rd on, ``2-4`` on
+the 2nd through 4th, ``*`` on every one, and ``p0.25`` on each with
+probability 0.25 drawn from a ``random.Random`` seeded by the plan's
+``seed=`` clause (default 0) — same seed, same dispatch order, same
+faults, every run.  ``arg`` is seconds for ``hang``/``delay`` (defaults
+30 and 0.05) and the byte fraction in [0, 1] for ``torn`` (default
+0.5); ``raise``, ``drop``, ``partition``, and ``enospc`` ignore it.
 
 Examples::
 
@@ -57,10 +72,13 @@ Examples::
     --inject-faults 'gossip:1-8:partition' # both gossip directions cut until
                                            # 8 outbound sends have been eaten
     --inject-faults 'proxy:1:drop'         # first proxy hop fails (retry path)
+    --inject-faults 'io-write:2:torn:0.25' # 2nd write stops at 25% of bytes
+    --inject-faults 'io-fsync:1+:enospc'   # the disk is full from here on
 """
 
 from __future__ import annotations
 
+import errno
 import random
 import threading
 import time
@@ -71,12 +89,15 @@ from mpi_tpu.config import ConfigError
 
 _ENGINE_SITES = ("step", "batched", "any")
 _NET_SITES = ("gossip", "proxy")
-_SITES = _ENGINE_SITES + _NET_SITES
+_IO_SITES = ("io-write", "io-fsync", "io-replace")
+_SITES = _ENGINE_SITES + _NET_SITES + _IO_SITES
 _ENGINE_MODES = ("raise", "hang", "delay")
 _NET_MODES = ("drop", "delay", "partition")
-_MODES = ("raise", "hang", "delay", "drop", "partition")
+_IO_MODES = ("raise", "torn", "enospc", "delay")
+_MODES = ("raise", "hang", "delay", "drop", "partition", "torn", "enospc")
 _DEFAULT_SECONDS = {"raise": 0.0, "hang": 30.0, "delay": 0.05,
-                    "drop": 0.0, "partition": 0.0}
+                    "drop": 0.0, "partition": 0.0,
+                    "torn": 0.5, "enospc": 0.0}
 
 
 class InjectedFault(RuntimeError):
@@ -88,6 +109,16 @@ class InjectedNetworkFault(RuntimeError):
     """What a 'drop' or 'partition' clause throws at a network site —
     the cluster layer maps it to ``PeerUnreachable``, so an injected
     split exercises exactly the real unreachable-peer paths."""
+
+
+class InjectedIOFault(OSError):
+    """What an io-site clause throws — an ``OSError`` with a real errno
+    (``EIO`` for raise/torn, ``ENOSPC`` for enospc), so the storage
+    plane's degradation machinery cannot special-case injected failures
+    apart from kernel ones."""
+
+    def __init__(self, eno: int, msg: str):
+        super().__init__(eno, msg)
 
 
 @dataclass(frozen=True)
@@ -140,7 +171,9 @@ class FaultPlan:
             if mode not in _MODES:
                 raise ConfigError(
                     f"bad fault mode {mode!r}; one of {_MODES}")
-            allowed = (_NET_MODES if site in _NET_SITES else _ENGINE_MODES)
+            allowed = (_NET_MODES if site in _NET_SITES
+                       else _IO_MODES if site in _IO_SITES
+                       else _ENGINE_MODES)
             if mode not in allowed:
                 raise ConfigError(
                     f"fault mode {mode!r} is not valid at site {site!r}; "
@@ -172,6 +205,9 @@ class FaultPlan:
                 raise ConfigError(f"bad fault seconds in {raw!r}")
             if seconds < 0:
                 raise ConfigError(f"fault seconds must be >= 0 in {raw!r}")
+            if mode == "torn" and not 0.0 <= seconds <= 1.0:
+                raise ConfigError(
+                    f"torn fraction must be in [0, 1] in {raw!r}")
             clauses.append(_Clause(site, lo, hi, prob, mode, seconds))
         if not clauses:
             raise ConfigError(f"fault spec {spec!r} has no clauses")
@@ -191,10 +227,12 @@ class FaultInjector:
         self.plan = plan
         self._lock = threading.Lock()
         self._counts = {"step": 0, "batched": 0, "any": 0,
-                        "gossip": 0, "proxy": 0}
+                        "gossip": 0, "proxy": 0,
+                        "io-write": 0, "io-fsync": 0, "io-replace": 0}
         self._rng = random.Random(plan.seed)
         self.injected = {"raise": 0, "hang": 0, "delay": 0,
-                         "drop": 0, "partition": 0}
+                         "drop": 0, "partition": 0,
+                         "torn": 0, "enospc": 0}
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultInjector":
@@ -258,6 +296,40 @@ class FaultInjector:
             time.sleep(seconds)
             return
         raise InjectedNetworkFault(msg)
+
+    def io_hook(self, site: str) -> Optional[float]:
+        """Called by :meth:`StateStore._io` immediately before a storage
+        syscall; ``site`` is 'io-write', 'io-fsync', or 'io-replace'.
+        Raises :class:`InjectedIOFault` (raise → ``EIO``, enospc →
+        ``ENOSPC``), sleeps through a delay, or returns the torn byte
+        fraction for the store to execute (the tear must happen at the
+        write itself so the torn prefix really lands on disk) — None
+        means proceed normally.  Same counter-under-lock,
+        effect-outside-lock discipline as the other hooks."""
+        action: Optional[Tuple[str, float, str]] = None
+        with self._lock:
+            self._counts[site] += 1
+            nth = self._counts[site]
+            for c in self.plan.clauses:
+                if c.site != site:
+                    continue
+                draw = self._rng.random() if c.prob is not None else None
+                if c.matches(nth, draw):
+                    action = (c.mode, c.seconds,
+                              f"injected {c.mode} at {site} call #{nth}")
+                    self.injected[c.mode] += 1
+                    break
+        if action is None:
+            return None
+        mode, seconds, msg = action
+        if mode == "delay":
+            time.sleep(seconds)
+            return None
+        if mode == "torn":
+            return seconds              # the byte fraction to keep
+        if mode == "enospc":
+            raise InjectedIOFault(errno.ENOSPC, msg)
+        raise InjectedIOFault(errno.EIO, msg)
 
     def inbound_cut(self, site: str) -> bool:
         """True while a ``partition`` clause at ``site`` still covers

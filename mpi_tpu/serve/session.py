@@ -322,6 +322,11 @@ class SessionManager:
                  ticket_ttl_s: float = 600.0,
                  state_dir: Optional[str] = None,
                  checkpoint_every: int = 64,
+                 state_degrade: str = "continue",
+                 state_journal: bool = True,
+                 journal_max_bytes: int = 1 << 20,
+                 journal_max_age_s: float = 300.0,
+                 state_keep: int = 2,
                  request_timeout_s: Optional[float] = None,
                  step_retries: int = 2,
                  retry_backoff_s: float = 0.05,
@@ -381,8 +386,29 @@ class SessionManager:
 
             faults = FaultInjector.from_spec(faults)
         self.faults = faults
-        self.store = (recovery.StateStore(state_dir, checkpoint_every)
-                      if state_dir else None)
+        # --state-degrade policy: what to do with session verbs while
+        # persistence is degraded.  "continue" (default) keeps serving
+        # and re-checkpoints when the disk heals; "readonly" refuses
+        # mutating verbs (503 + Retry-After); "shed" refuses all
+        # session verbs so a balancer drains this node
+        if state_degrade not in ("continue", "readonly", "shed"):
+            raise ValueError(
+                f"state_degrade must be continue|readonly|shed, "
+                f"got {state_degrade!r}")
+        self.state_degrade = state_degrade
+        self.store = (recovery.StateStore(
+            state_dir, checkpoint_every,
+            journal=state_journal,
+            journal_max_bytes=journal_max_bytes,
+            journal_max_age_s=journal_max_age_s,
+            keep=state_keep)
+            if state_dir else None)
+        if self.store is not None:
+            self.store.obs = obs
+            if self.faults is not None:
+                # the io fault sites fire inside StateStore._io — the
+                # one choke point every persisted byte flows through
+                self.store.fault_hook = self.faults.io_hook
         self.engine_failures = 0
         self.watchdog_timeouts = 0
         self.degraded_total = 0
@@ -476,7 +502,9 @@ class SessionManager:
                 grid_np = session.engine.fetch(session.grid)
             else:
                 grid_np = np.asarray(session.grid, dtype=np.uint8)
-            self._persist(session, grid_np)
+            # a drain/recovery checkpoint MUST land or visibly fail —
+            # the caller decides whether to hand the session off
+            self._persist(session, grid_np, raise_errors=True)
 
     def release(self, sid: str) -> None:
         """Drop a session locally WITHOUT deleting its durable record —
@@ -493,6 +521,50 @@ class SessionManager:
             session.engine = None
         if self.admission is not None:
             self.admission.gate.drop_session(sid)
+        if self.store is not None:
+            # drop in-memory journal state only — the durable chain is
+            # the successor's restore source
+            self.store.forget(sid)
+
+    def persistence_retry(self) -> None:
+        """Flush the degraded-store backlog when the retry backoff has
+        elapsed (called from lock-free seams: the top of ``step`` and
+        ``health``).  Each pending session gets a fresh full-snapshot
+        checkpoint — the write that failed may have been a journal
+        entry whose in-memory diff base is long gone.  The first write
+        is the probe; if the disk is still sick the store re-arms its
+        backoff and this returns quietly."""
+        store = self.store
+        if store is None or not store.retry_ready():
+            return
+        try:
+            store.retry_deletes()
+        except OSError:
+            return
+        for sid in store.take_pending():
+            try:
+                self.checkpoint_now(sid)
+            except KeyError:
+                store.discard_pending(sid)  # released/closed meanwhile
+            except OSError:
+                return                  # still sick; backoff re-armed
+
+    def _storage_gate(self, mutating: bool = True) -> None:
+        """Enforce ``--state-degrade`` while persistence is degraded:
+        ``readonly`` refuses mutating session verbs, ``shed`` refuses
+        all of them (``continue``, the default, refuses nothing).  The
+        transport maps the raise to a structured 503 with Retry-After
+        sized by the store's backoff."""
+        store = self.store
+        if store is None or self.state_degrade == "continue":
+            return
+        if not store.is_degraded():
+            return
+        if self.state_degrade == "shed" or mutating:
+            wait = max(store.retry_in_s(), 0.5)
+            raise recovery.StorageDegradedError(
+                f"persistence degraded and --state-degrade is "
+                f"{self.state_degrade}; retry in {wait:.1f}s", wait)
 
     def session_ids(self) -> list:
         with self._lock:
@@ -517,6 +589,7 @@ class SessionManager:
 
     def _create(self, spec: dict, sid: Optional[str] = None,
                 tenant: Optional[str] = None) -> dict:
+        self._storage_gate(mutating=True)
         config, segments = _parse_spec(spec)
         adm = self.admission
         if adm is not None:
@@ -689,13 +762,16 @@ class SessionManager:
 
     # -- checkpoint / restore ---------------------------------------------
 
-    def _persist(self, session: Session, grid_np=None) -> None:  # lint: disable=lock-discipline -- caller holds session.lock (step path) or the session is pre-publication (create/restore)
-        """Write the session's durable record (caller holds the session
-        lock on the step path; create/restore call it pre-publication).
-        ``grid_np``: a freshly fetched host grid to snapshot, or None to
-        keep the previous snapshot.  Store failures are counted, noted,
-        and swallowed — durability must degrade, not take the step down
-        with it."""
+    def _persist(self, session: Session, grid_np=None,  # lint: disable=lock-discipline -- caller holds session.lock (step path) or the session is pre-publication (create/restore)
+                 raise_errors: bool = False) -> None:
+        """Write the session's full durable record (caller holds the
+        session lock on the step path; create/restore call it
+        pre-publication).  ``grid_np``: a freshly fetched host grid to
+        snapshot, or None to keep the previous snapshot.  Store failures
+        are counted, noted, and swallowed — durability must degrade, not
+        take the step down with it — unless ``raise_errors`` (the drain
+        path: handing off a session whose checkpoint did not land would
+        lose generations)."""
         if self.store is None or session.spec is None:
             return
         try:
@@ -712,17 +788,27 @@ class SessionManager:
                 self.obs.event("checkpoint_write", dt, t0, sid=session.id,
                                generation=session.generation,
                                snapshot=grid_np is not None)
+        except recovery.StorageDegradedError:
+            # fast-fail while degraded: already queued as pending and
+            # counted by the store; no stderr spam per skipped write
+            if raise_errors:
+                raise
         except Exception as e:  # noqa: BLE001 — durability is best-effort
             self.store_errors += 1
             print(f"note: state-dir write failed for {session.id}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+            if raise_errors:
+                raise
 
     def _checkpoint(self, session: Session) -> None:  # lint: disable=lock-discipline -- caller holds session.lock (documented contract)
-        """Persist a committed step (caller holds ``session.lock``).  The
-        generation is recorded every step; the packed grid snapshot only
-        every ``checkpoint_every`` generations (fetching the device grid
-        is a sync)."""
-        if self.store is None:
+        """Persist a committed step (caller holds ``session.lock``).
+        The generation lands every step — as an appended journal entry
+        when journaling (a content delta when the grid rode along, a
+        bare mark otherwise; the store compacts to a full record on its
+        size/age triggers), as a full record rewrite otherwise.  The
+        grid is fetched only every ``checkpoint_every`` generations
+        (fetching the device grid is a sync)."""
+        if self.store is None or session.spec is None:
             return
         grid_np = None
         last = session.ckpt["generation"] if session.ckpt else 0
@@ -737,7 +823,35 @@ class SessionManager:
                 print(f"note: checkpoint fetch failed for {session.id}: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
                 grid_np = None
-        self._persist(session, grid_np)
+        try:
+            t0 = time.perf_counter()
+            if grid_np is not None:
+                snap = recovery.encode_grid(grid_np)
+                snap["generation"] = session.generation
+                session.ckpt = snap
+            info = self.store.commit_step(session.id, session.spec,
+                                          session.generation, session.ckpt,
+                                          grid=grid_np)
+            if self.obs is not None:
+                dt = time.perf_counter() - t0
+                if info["form"] == "journal":
+                    self.obs.event("journal_append", dt, t0,
+                                   sid=session.id,
+                                   generation=session.generation,
+                                   kind=info["kind"],
+                                   bytes=info["bytes"])
+                else:
+                    self.obs.checkpoint_write.observe(dt)
+                    self.obs.event("checkpoint_write", dt, t0,
+                                   sid=session.id,
+                                   generation=session.generation,
+                                   snapshot=grid_np is not None)
+        except recovery.StorageDegradedError:
+            pass                        # queued as pending; retried later
+        except Exception as e:  # noqa: BLE001 — durability is best-effort
+            self.store_errors += 1
+            print(f"note: state-dir write failed for {session.id}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
 
     def _restore_all(self) -> None:
         for rec in self.store.load_records():
@@ -918,6 +1032,8 @@ class SessionManager:
         new depth.  The sync path never sets any of them."""
         if steps < 1:
             raise ConfigError(f"steps must be >= 1, got {steps}")
+        self.persistence_retry()
+        self._storage_gate(mutating=True)
         deadline = (_deadline if _deadline is not None
                     else _Deadline(self._budget(timeout_s)))
         attempt = 0
@@ -1147,6 +1263,7 @@ class SessionManager:
             raise ConfigError("async stepping is disabled (--no-async)")
         if steps < 1:
             raise ConfigError(f"steps must be >= 1, got {steps}")
+        self._storage_gate(mutating=True)   # reject at enqueue, not resolve
         session = self.get(sid)         # unknown session -> 404 at enqueue
         deadline = _Deadline(self._budget(timeout_s))
         t0 = time.perf_counter()
@@ -1205,6 +1322,7 @@ class SessionManager:
         return out
 
     def snapshot(self, sid: str, timeout_s: Optional[float] = None) -> dict:
+        self._storage_gate(mutating=False)
         deadline = _Deadline(self._budget(timeout_s))
         return _watchdog_call(lambda: self._snapshot(sid), deadline,
                               f"snapshot({sid})")
@@ -1256,6 +1374,7 @@ class SessionManager:
 
     def _write_board(self, sid: str, grid,
                      generation: Optional[int]) -> dict:
+        self._storage_gate(mutating=True)
         session = self.get(sid)
         arr = np.ascontiguousarray(grid, dtype=np.uint8)
         shape = (session.config.rows, session.config.cols)
@@ -1491,6 +1610,7 @@ class SessionManager:
         answers 503 — exactly when the service is degraded with no
         fallback: some breaker is open and degradation is disabled, so
         requests on those plans cannot be served at all."""
+        self.persistence_retry()        # the probe rides health checks too
         with self._lock:
             sessions = list(self._sessions.values())
         br = self.cache.breaker_stats()
@@ -1512,6 +1632,18 @@ class SessionManager:
             "faults_injected": (sum(self.faults.injected.values())
                                 if self.faults is not None else 0),
         }
+        if self.store is not None:
+            # the closed->degraded->recovering state machine, pending
+            # backlog, and seconds to the next disk probe — always in
+            # the body.  "ok" flips only when the degrade policy blocks
+            # verbs (readonly/shed): under "continue" the node still
+            # serves everything, and a 503 would make a balancer evict
+            # a node that is working as designed
+            pers = self.store.persistence_state()
+            out["persistence"] = pers
+            if pers["state"] == "degraded" \
+                    and self.state_degrade != "continue":
+                out["ok"] = False
         if self.obs is not None and self.obs.slo is not None:
             # alerting, not readiness: a burning SLO (even critical
             # availability) never flips "ok" — the probe keys readiness

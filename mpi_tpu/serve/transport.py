@@ -57,6 +57,7 @@ from mpi_tpu.obs.tracectx import (
     parse_traceparent, reset_trace_context, set_trace_context, stitch_spans,
 )
 from mpi_tpu.serve import wire
+from mpi_tpu.serve.recovery import StorageDegradedError
 from mpi_tpu.serve.session import (
     DeadlineError, EngineStepError, EngineUnavailableError, SessionManager,
     TicketQueueFullError, format_grid_rows, parse_grid_rows,
@@ -346,6 +347,19 @@ class AppCore:
                 payload["trace_id"] = ctx.trace_id
             resp = json_response(503, payload)
             resp.headers.append(retry_after_header(1.0))
+            return resp
+        except StorageDegradedError as e:
+            # the storage plane is degraded and the --state-degrade
+            # policy blocks this verb: same structured-503 contract as
+            # every other backpressure answer, with Retry-After sized
+            # to the persistence retry backoff — never a traceback
+            payload = {"error": str(e), "persistence": "degraded",
+                       "request_id": rid}
+            ctx = current_trace_context()
+            if ctx is not None:
+                payload["trace_id"] = ctx.trace_id
+            resp = json_response(503, payload)
+            resp.headers.append(retry_after_header(e.retry_after_s))
             return resp
         except (DeadlineError, EngineUnavailableError,
                 EngineStepError) as e:

@@ -1,0 +1,742 @@
+"""Tier-1 chaos matrix for the durable state plane (ISSUE 18):
+CRC record envelopes + last-good chains, incremental journals, the
+``io-*`` fault sites through ``StateStore._io``, the
+closed→degraded→recovering persistence state machine, scrub, and the
+cluster degraded-bit/partial-corruption failover paths.
+
+The recurring assertion is the tentpole acceptance criterion: under
+injected io faults, torn writes at every byte offset, single-bit rot,
+and SIGKILL, restore and failover adoption recover **bit-identically**
+to the last durable generation — and a fully corrupt head falls back to
+the last-good ancestor with the session still serving.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.serve import recovery
+from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.faults import ConfigError, FaultInjector, InjectedIOFault
+from mpi_tpu.serve.recovery import (
+    RecordCorrupt,
+    StateStore,
+    StorageDegradedError,
+    scan_state_dir,
+)
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _oracle(rows, cols, seed, steps, boundary="periodic", rule=LIFE):
+    return evolve_np(init_tile_np(rows, cols, seed), steps, rule, boundary)
+
+
+def _grid_of(snap):
+    return np.array([[int(c) for c in row] for row in snap["grid"]],
+                    dtype=np.uint8)
+
+
+def _snap_of(rec):
+    return recovery.decode_grid(rec["snapshot"])
+
+
+# --------------------------------------------------- v2 envelope + v1
+
+
+def test_v2_envelope_magic_and_crc(tmp_path):
+    store = StateStore(str(tmp_path))
+    spec = {"rows": 16, "cols": 16, "backend": "serial", "seed": 3}
+    store.save("s1", spec, 5, None)
+    raw = (tmp_path / "s1.json").read_bytes()
+    assert raw[:4] == b"GOLS" and raw[4] == recovery.RECORD_VERSION
+    rec = recovery._rec_decode(raw)
+    assert rec["id"] == "s1" and rec["generation"] == 5
+    # any payload byte flip fails the CRC — never silently decoded
+    bad = bytearray(raw)
+    bad[len(raw) // 2] ^= 0x40
+    with pytest.raises(RecordCorrupt):
+        recovery._rec_decode(bytes(bad))
+
+
+def test_v1_record_loads_and_auto_upgrades_to_v2(tmp_path):
+    """A PR-3-era bare-JSON record restores bit-identically AND the
+    session's next persisted write rewrites it as a v2 envelope —
+    MIGRATION.md's auto-upgrade path."""
+    k = 4
+    g = _oracle(16, 16, 2, k)
+    snap = recovery.encode_grid(g)
+    snap["generation"] = k
+    (tmp_path / "s1.json").write_text(json.dumps({
+        "v": 1, "id": "s1", "generation": k,
+        "spec": {"rows": 16, "cols": 16, "backend": "serial", "seed": 2},
+        "snapshot": snap,
+    }))
+    mgr = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                         checkpoint_every=1)
+    assert mgr.restored_sessions == 1
+    assert np.array_equal(_grid_of(mgr.snapshot("s1")), g)
+    raw = (tmp_path / "s1.json").read_bytes()
+    assert raw[:4] == b"GOLS", "restore must rewrite the v1 record as v2"
+    mgr.step("s1", 1)
+    m2 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path))
+    assert np.array_equal(_grid_of(m2.snapshot("s1")),
+                          _oracle(16, 16, 2, k + 1))
+
+
+# ------------------------------------- torn / rotted records fall back
+
+
+def _seeded_chain(tmp_path, keep=2):
+    """A store with a two-deep last-good chain for s1: ancestor at gen 3,
+    head at gen 6 (journal off — the records-only chain)."""
+    store = StateStore(str(tmp_path), journal=False, keep=keep)
+    spec = {"rows": 16, "cols": 16, "backend": "serial", "seed": 7}
+    for gen in (3, 6):
+        snap = recovery.encode_grid(_oracle(16, 16, 7, gen))
+        snap["generation"] = gen
+        store.save("s1", spec, gen, snap)
+    return store, spec
+
+
+def test_torn_head_at_every_offset_recovers_a_durable_generation(tmp_path):
+    """Truncate the head record at EVERY byte offset (the shape any torn
+    write can leave): restore must always land on a verifiable state —
+    the intact head (full length only) or the gen-3 ancestor — and the
+    recovered board must equal the oracle at the recovered generation.
+    Never None, never garbage."""
+    _seeded_chain(tmp_path / "seed")
+    head = (tmp_path / "seed" / "s1.json").read_bytes()
+    for off in range(len(head)):
+        d = tmp_path / f"t{off}"
+        shutil.copytree(tmp_path / "seed", d)
+        (d / "s1.json").write_bytes(head[:off])
+        store = StateStore(str(d), journal=False)
+        rec = store.load_record("s1")
+        assert rec is not None, f"offset {off}: nothing recovered"
+        assert rec["generation"] == 3, f"offset {off}: wrong anchor"
+        assert np.array_equal(_snap_of(rec), _oracle(16, 16, 7, 3))
+        assert store.corrupt_records == 1
+        assert any(f.name.startswith("s1.corrupt-") for f in d.iterdir())
+        shutil.rmtree(d)
+
+
+def test_single_bitflip_fuzz_quarantines_and_falls_back(tmp_path):
+    """Rot any single bit anywhere in the head record — header, length,
+    CRC field, payload — and restore detects it, quarantines the head,
+    and serves the last-good ancestor."""
+    _seeded_chain(tmp_path / "seed")
+    head = (tmp_path / "seed" / "s1.json").read_bytes()
+    for pos in range(0, len(head), 7):          # stride keeps tier-1 fast
+        d = tmp_path / f"b{pos}"
+        shutil.copytree(tmp_path / "seed", d)
+        bad = bytearray(head)
+        bad[pos] ^= 1 << (pos % 8)
+        (d / "s1.json").write_bytes(bytes(bad))
+        store = StateStore(str(d), journal=False)
+        rec = store.load_record("s1")
+        assert rec is not None and rec["generation"] == 3, f"bit {pos}"
+        assert np.array_equal(_snap_of(rec), _oracle(16, 16, 7, 3))
+        assert store.corrupt_records == 1, f"bit {pos}: no quarantine"
+        shutil.rmtree(d)
+
+
+def test_corrupt_head_session_still_serves(tmp_path):
+    """The acceptance wording verbatim: a fully corrupt head falls back
+    to the last-good ancestor and the session KEEPS SERVING — restore
+    succeeds, steps continue on the oracle from the recovered state."""
+    _seeded_chain(tmp_path)
+    (tmp_path / "s1.json").write_bytes(os.urandom(128))
+    mgr = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path))
+    assert mgr.restored_sessions == 1
+    assert mgr.get("s1").generation == 3
+    mgr.step("s1", 2)
+    assert np.array_equal(_grid_of(mgr.snapshot("s1")),
+                          _oracle(16, 16, 7, 5))
+
+
+# --------------------------------------------------- journal replay
+
+
+def test_journal_entries_replay_bit_identically(tmp_path):
+    """checkpoint_every=1 journals a content entry per committed step;
+    restore folds them and lands exactly on the oracle with zero
+    replay."""
+    k = 9
+    m1 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                        checkpoint_every=1)
+    sid = m1.create({"rows": 24, "cols": 24, "backend": "serial",
+                     "seed": 11})["id"]
+    for _ in range(k):
+        m1.step(sid, 1)
+    st = m1.store.stats()
+    assert st["journal_appends"] == k and st["journal"] is True
+    m2 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path))
+    assert m2.get(sid).generation == k
+    assert np.array_equal(_grid_of(m2.snapshot(sid)),
+                          _oracle(24, 24, 11, k))
+
+
+def test_torn_journal_tail_at_every_offset_loses_only_the_tail(tmp_path):
+    """Truncate the journal at EVERY byte offset: restore must recover
+    exactly the longest intact entry prefix — generation equals the last
+    whole entry's, the board equals the oracle there, and nothing before
+    the tear is lost."""
+    k = 5
+    m1 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                        checkpoint_every=1)
+    sid = m1.create({"rows": 16, "cols": 16, "backend": "serial",
+                     "seed": 5})["id"]
+    for _ in range(k):
+        m1.step(sid, 1)
+    jraw = (tmp_path / f"{sid}.journal").read_bytes()
+    entries, _, torn = recovery._jrn_scan(jraw)
+    assert len(entries) == k and not torn
+    # entry boundaries: generation recovered at a cut inside entry i+1
+    # is entry i's
+    bounds = []
+    off = 0
+    for kind, gen, payload in entries:
+        off += recovery._JRN_HEADER.size + len(payload)
+        bounds.append((off, gen))
+    base_gen = 0                      # record generation at create time
+    for cut in range(len(jraw) + 1):
+        d = tmp_path / f"c{cut}"
+        d.mkdir()
+        shutil.copy(tmp_path / f"{sid}.json", d / f"{sid}.json")
+        (d / f"{sid}.journal").write_bytes(jraw[:cut])
+        want = base_gen
+        for end, gen in bounds:
+            if cut >= end:
+                want = gen
+        store = StateStore(str(d))
+        rec = store.load_record(sid)
+        assert rec is not None
+        assert rec["generation"] == want, f"cut {cut}"
+        if rec.get("snapshot") is not None:
+            got = recovery.decode_grid(rec["snapshot"])
+            assert np.array_equal(
+                got, _oracle(16, 16, 5, rec["snapshot"]["generation"])), \
+                f"cut {cut}"
+        shutil.rmtree(d)
+
+
+def test_journal_compaction_size_trigger_and_restore_parity(tmp_path):
+    """A tiny journal_max_bytes forces compaction: journals fold back
+    into full records, the counter rings, and restore still lands on the
+    oracle."""
+    k = 8
+    m1 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                        checkpoint_every=1, journal_max_bytes=64)
+    sid = m1.create({"rows": 16, "cols": 16, "backend": "serial",
+                     "seed": 9})["id"]
+    for _ in range(k):
+        m1.step(sid, 1)
+    st = m1.store.stats()
+    assert st["compactions"] > 0
+    assert st["bytes_full"] > 0 and st["bytes_delta"] > 0
+    m2 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path))
+    assert m2.get(sid).generation == k
+    assert np.array_equal(_grid_of(m2.snapshot(sid)),
+                          _oracle(16, 16, 9, k))
+
+
+def test_journal_marks_between_snapshots_replay_from_snapshot(tmp_path):
+    """checkpoint_every > 1 journals bare marks between grid fetches:
+    restore replays deterministically from the last content state to the
+    last marked generation."""
+    m1 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                        checkpoint_every=4)
+    sid = m1.create({"rows": 16, "cols": 16, "backend": "serial",
+                     "seed": 8})["id"]
+    for _ in range(6):                      # snapshot at 4, marks at 5-6
+        m1.step(sid, 1)
+    m2 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path))
+    assert m2.get(sid).generation == 6
+    assert np.array_equal(_grid_of(m2.snapshot(sid)),
+                          _oracle(16, 16, 8, 6))
+
+
+# ------------------------------------------------ io fault site family
+
+
+def test_fault_plan_parses_io_sites_and_modes():
+    for spec in ("io-write:1:raise", "io-fsync:2+:enospc",
+                 "io-replace:1-3:torn:0.25", "io-write:p0.5:delay:0.01",
+                 "seed=3,io-write:2:torn"):
+        FaultInjector.from_spec(spec)
+
+
+@pytest.mark.parametrize("bad", [
+    "io-write:1:hang",                  # engine mode on an io site
+    "io-write:1:drop",                  # net mode on an io site
+    "step:1:torn",                      # io mode on an engine site
+    "io-write:1:torn:1.5",              # tear fraction out of [0, 1]
+    "io-write:1:torn:-0.1",
+])
+def test_fault_plan_rejects_cross_family_io_modes(bad):
+    with pytest.raises(ConfigError):
+        FaultInjector.from_spec(bad)
+
+
+def test_io_torn_write_tears_at_the_fraction_and_store_degrades(tmp_path):
+    store = StateStore(str(tmp_path))
+    store.fault_hook = FaultInjector.from_spec("io-write:1:torn:0.25").io_hook
+    spec = {"rows": 16, "cols": 16, "backend": "serial", "seed": 1}
+    with pytest.raises(OSError):
+        store.save("s1", spec, 1, None)
+    assert store.is_degraded()
+    assert store.persistence_state()["state"] == "degraded"
+    assert not list(tmp_path.glob("*.tmp*")), "torn tmp must be cleaned"
+    # fast-fail while the backoff pends: no disk touch, pending queued
+    with pytest.raises(StorageDegradedError) as ei:
+        store.save("s1", spec, 2, None)
+    assert ei.value.retry_after_s > 0
+    assert store.persist_skipped == 1
+    assert store.take_pending() == ["s1"]
+    # after the backoff the probe lands (the fault clause is spent) and
+    # the machine closes
+    store._retry_at = 0.0
+    store.save("s1", spec, 3, None)
+    assert store.persistence_state()["state"] == "closed"
+    assert store.load_record("s1")["generation"] == 3
+
+
+def test_io_enospc_hook_raises_enospc():
+    inj = FaultInjector.from_spec("io-write:1:enospc")
+    with pytest.raises(InjectedIOFault) as ei:
+        inj.io_hook("io-write")
+    import errno
+    assert ei.value.errno == errno.ENOSPC
+    assert inj.stats()["injected"]["enospc"] == 1
+    assert inj.io_hook("io-write") is None      # clause spent
+
+
+def test_enospc_degraded_recovery_roundtrip_zero_lost_generations(tmp_path):
+    """The disk 'fills' on the first commit, the server keeps serving
+    (policy continue), and once the backoff elapses the pending backlog
+    flushes — a restart then restores the exact final generation."""
+    mgr = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                         checkpoint_every=1, faults="io-write:2:enospc")
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial",
+                      "seed": 6})["id"]
+    mgr.step(sid, 1)                    # commit write #2 hits ENOSPC
+    assert mgr.store.is_degraded()
+    h = mgr.health()
+    assert h["ok"] is True              # continue: degraded is not down
+    assert h["persistence"]["state"] == "degraded"
+    assert h["persistence"]["pending"] >= 1
+    mgr.step(sid, 1)                    # serves; persistence fast-fails
+    assert mgr.get(sid).generation == 2
+    mgr.store._retry_at = 0.0           # elapse the backoff
+    h = mgr.health()                    # the probe rides health checks
+    assert h["persistence"]["state"] == "closed"
+    assert h["persistence"]["pending"] == 0
+    m2 = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path))
+    assert m2.get(sid).generation == 2, "recovered flush lost generations"
+    assert np.array_equal(_grid_of(m2.snapshot(sid)),
+                          _oracle(16, 16, 6, 2))
+
+
+def test_state_degrade_readonly_blocks_mutations_serves_reads(tmp_path):
+    mgr = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                         checkpoint_every=1, state_degrade="readonly",
+                         faults="io-write:2-99:raise")
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial",
+                      "seed": 4})["id"]
+    mgr.step(sid, 1)                    # commit fails -> degraded
+    assert mgr.store.is_degraded()
+    with pytest.raises(StorageDegradedError) as ei:
+        mgr.step(sid, 1)
+    assert 0 < ei.value.retry_after_s <= 30.0
+    mgr.snapshot(sid)                   # reads keep serving
+    assert mgr.health()["ok"] is False  # readonly degraded flips healthz
+
+
+def test_state_degrade_shed_blocks_reads_too(tmp_path):
+    mgr = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                         checkpoint_every=1, state_degrade="shed",
+                         faults="io-write:2-99:raise")
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial",
+                      "seed": 4})["id"]
+    mgr.step(sid, 1)
+    with pytest.raises(StorageDegradedError):
+        mgr.snapshot(sid)
+    with pytest.raises(StorageDegradedError):
+        mgr.create({"rows": 8, "cols": 8, "backend": "serial"})
+    assert mgr.health()["ok"] is False
+
+
+def test_state_degrade_rejects_unknown_policy(tmp_path):
+    with pytest.raises(ValueError):
+        SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                       state_degrade="panic")
+
+
+def test_transport_maps_degraded_to_structured_503(tmp_path):
+    """The PR-16 contract for storage failures: a structured 503 body
+    with ``persistence: degraded`` and a Retry-After sized to the
+    persistence backoff — never a traceback — and /healthz carries the
+    persistence block."""
+    from mpi_tpu.serve.httpd import make_server
+
+    mgr = SessionManager(EngineCache(max_size=2), state_dir=str(tmp_path),
+                         checkpoint_every=1, state_degrade="shed",
+                         faults="io-write:2-99:raise")
+    sid = mgr.create({"rows": 16, "cols": 16, "backend": "serial",
+                      "seed": 4})["id"]
+    mgr.step(sid, 1)                    # -> degraded
+    srv = make_server("127.0.0.1", 0, mgr)
+    host, port = srv.server_address[:2]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        import http.client
+        conn = http.client.HTTPConnection(f"{host}:{port}", timeout=30)
+        conn.request("POST", f"/sessions/{sid}/step",
+                     body=json.dumps({"steps": 1}).encode())
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 503
+        assert body["persistence"] == "degraded"
+        assert "error" in body and "request_id" in body
+        ra = dict(resp.getheaders()).get("Retry-After")
+        assert ra is not None and ra.isdigit() and int(ra) >= 1
+        conn.close()
+        conn = http.client.HTTPConnection(f"{host}:{port}", timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        h = json.loads(resp.read())
+        assert resp.status == 503 and h["ok"] is False
+        assert h["persistence"]["state"] == "degraded"
+        conn.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# --------------------------------------------------------------- scrub
+
+
+def _scrub(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scrub.py"), *args],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_scrub_reports_repairs_and_exit_codes(tmp_path):
+    store = StateStore(str(tmp_path), checkpoint_every=1)
+    spec = {"rows": 16, "cols": 16, "backend": "serial", "seed": 1}
+    store.save("s1", spec, 0, None)
+    g = init_tile_np(16, 16, 1)
+    for gen in (1, 2, 3):
+        store.commit_step("s1", spec, gen, None, grid=g)
+    store.save("s2", spec, 0, None)
+    raw = bytearray((tmp_path / "s2.json").read_bytes())
+    raw[8] ^= 0xFF
+    (tmp_path / "s2.json").write_bytes(bytes(raw))
+    with open(tmp_path / "s1.journal", "ab") as f:
+        f.write(b"\x00torn tail")
+    (tmp_path / "s3.json.tmp7").write_bytes(b"interrupted")
+    (tmp_path / "routing-ab12cd.json").write_text('{"v": 2, "routes": {}}')
+
+    r1 = _scrub(str(tmp_path))
+    assert r1.returncode == 1, r1.stdout + r1.stderr
+    assert "torn tail" in r1.stdout and "stale tmp" in r1.stdout
+    r2 = _scrub(str(tmp_path), "--repair")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    r3 = _scrub(str(tmp_path), "--json")
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+    rpt = json.loads(r3.stdout)
+    assert rpt["clean"] and rpt["records_ok"] >= 1
+    assert rpt["journal_entries"] == 3
+    # quarantined, not deleted; routing table untouched
+    assert any(f.name.startswith("s2.corrupt-") for f in tmp_path.iterdir())
+    assert (tmp_path / "routing-ab12cd.json").exists()
+    # repaired dir restores: s1 at its journaled generation, s2 lost
+    # loudly (quarantined), never garbage
+    store2 = StateStore(str(tmp_path), checkpoint_every=1)
+    recs = store2.load_records()
+    assert [r["id"] for r in recs] == ["s1"]
+    assert recs[0]["generation"] == 3
+
+
+def test_scrub_internal_error_exits_2(tmp_path):
+    f = tmp_path / "not-a-dir"
+    f.write_text("x")
+    r = _scrub(str(f))
+    assert r.returncode == 2
+    assert "internal error" in r.stderr
+
+
+def test_scan_state_dir_repair_truncates_torn_tail_in_place(tmp_path):
+    store = StateStore(str(tmp_path), checkpoint_every=1)
+    spec = {"rows": 16, "cols": 16, "backend": "serial", "seed": 2}
+    store.save("s1", spec, 0, None)
+    g = init_tile_np(16, 16, 2)
+    store.commit_step("s1", spec, 1, None, grid=g)
+    jpath = tmp_path / "s1.journal"
+    good = jpath.read_bytes()
+    jpath.write_bytes(good + good[: len(good) // 2])    # torn re-append
+    rpt = scan_state_dir(str(tmp_path), repair=True)
+    assert rpt["torn_tails"] == 1
+    assert jpath.read_bytes() == good, "repair must cut exactly the tail"
+    assert scan_state_dir(str(tmp_path))["clean"]
+
+
+# ------------------------------------------------------------- cluster
+
+# the in-process pair harness from tests/test_cluster.py, trimmed to
+# what the durability paths need
+from mpi_tpu.cluster import ClusterNode  # noqa: E402
+from mpi_tpu.serve.httpd import make_server  # noqa: E402
+
+
+class _Node:
+    def __init__(self, state_dir=None, faults=None):
+        self.mgr = SessionManager(EngineCache(max_size=4), batching=False,
+                                  state_dir=state_dir, faults=faults)
+        self.srv = make_server("127.0.0.1", 0, self.mgr)
+        host, port = self.srv.server_address[:2]
+        self.addr = f"{host}:{port}"
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.node = None
+
+    def join(self, peers, state_dir=None, **kw):
+        self.node = ClusterNode(self.addr, peers, self.mgr,
+                                interval_s=3600.0, state_dir=state_dir,
+                                **kw)
+        self.mgr.attach_cluster(self.node)
+        self.srv.core.cluster = self.node
+        return self.node
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_gossip_carries_the_degraded_bit(tmp_path):
+    state = str(tmp_path / "shared")
+    a, b = _Node(state_dir=state), _Node(state_dir=state)
+    a.join([b.addr], state_dir=state)
+    b.join([a.addr], state_dir=state)
+    try:
+        assert a.node.digest()["persist_degraded"] is False
+        b.mgr.store._io_fail(None)              # b's disk dies
+        assert b.node.digest()["persist_degraded"] is True
+        b.node.gossip_now()
+        assert a.node.peers[b.addr].persist_degraded is True
+        b.mgr.store._io_ok(None)                # heals
+        b.node.gossip_now()
+        assert a.node.peers[b.addr].persist_degraded is False
+    finally:
+        a.close()
+        b.close()
+
+
+def test_failover_refuses_adoption_from_degraded_peer(tmp_path):
+    """A dead peer whose last gossiped persistence bit was degraded has
+    known-unwritten checkpoints: adopting its records would silently
+    serve stale boards, so failover counts them lost — loudly — and
+    leaves the state dir to the scrub runbook."""
+    state = str(tmp_path / "shared")
+    a, b = _Node(state_dir=state), _Node(state_dir=state)
+    a.join([b.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    b.join([a.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    try:
+        # place sessions directly on b (manager-level create pins them)
+        for i in range(2):
+            b.mgr.create({"rows": 16, "cols": 16, "backend": "serial",
+                          "seed": i})
+        orphans = sorted(b.mgr.session_ids())
+        assert orphans
+        b.mgr.store._io_fail(None)              # b's disk dies...
+        b.node.gossip_now()                     # ...and a hears about it
+        assert a.node.peers[b.addr].persist_degraded is True
+        b.close()
+        time.sleep(0.15)
+        assert a.node.check_membership() == [b.addr]
+        assert a.node.failover_adopted == 0
+        assert a.node.failover_lost >= len(orphans)
+        assert not (set(orphans) & set(a.mgr.session_ids())), \
+            "degraded peer's sessions must NOT be silently adopted"
+        assert a.node._dead[b.addr]["persist_degraded"] is True
+    finally:
+        a.close()
+        b.close()
+
+
+def test_failover_adopts_good_sessions_from_partially_corrupt_dir(tmp_path):
+    """Some of the dead peer's records rotted, some are fine: the bad
+    ones quarantine and count lost, every good one is adopted
+    bit-identically — partial corruption never blocks the salvageable
+    majority."""
+    state = str(tmp_path / "shared")
+    a, b = _Node(state_dir=state), _Node(state_dir=state)
+    a.join([b.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    b.join([a.addr], state_dir=state, down_after_s=0.05, dead_after_s=0.12)
+    try:
+        sids, seeds, i = [], {}, 0
+        while len(sids) < 3:
+            sid = b.mgr.create({"rows": 16, "cols": 16,
+                                "backend": "serial", "seed": i})["id"]
+            seeds[sid] = i
+            sids.append(sid)
+            i += 1
+        gens = {}
+        for j, sid in enumerate(sids):
+            b.mgr.step(sid, 2 + j)
+            gens[sid] = 2 + j
+        a.node.gossip_now()
+        b.node.gossip_now()
+        victim = sids[0]
+        # rot the victim's whole chain: head + every ancestor
+        for p in (tmp_path / "shared").iterdir():
+            if p.name.startswith(f"{victim}."):
+                p.write_bytes(os.urandom(64))
+        b.close()
+        time.sleep(0.15)
+        assert a.node.check_membership() == [b.addr]
+        assert a.node.failover_lost >= 1
+        assert a.node.failover_adopted == len(sids) - 1
+        for sid in sids[1:]:
+            assert sid in set(a.mgr.session_ids())
+            snap = a.mgr.snapshot(sid)
+            assert snap["generation"] == gens[sid]
+            assert np.array_equal(
+                _grid_of(snap), _oracle(16, 16, seeds[sid], gens[sid]))
+        assert victim not in set(a.mgr.session_ids())
+    finally:
+        a.close()
+        b.close()
+
+
+def test_drain_under_io_write_raise_keeps_batch_local(tmp_path):
+    """The drain checkpoint must land before handoff — with the disk
+    raising on every write, the batch stays local, still served, zero
+    lost generations."""
+    state = str(tmp_path / "shared")
+    a, b = _Node(state_dir=state), _Node(state_dir=state)
+    a.join([b.addr], state_dir=state)
+    b.join([a.addr], state_dir=state)
+    try:
+        sid = a.mgr.create({"rows": 16, "cols": 16, "backend": "serial",
+                            "seed": 3})["id"]
+        a.mgr.step(sid, 4)
+        inj = FaultInjector.from_spec("io-write:1-999:raise")
+        a.mgr.store.fault_hook = inj.io_hook
+        out = a.node.drain()
+        assert out["ok"] is False and out["errors"], out
+        assert sid not in out["handoffs"].get(b.addr, []), out
+        assert sid in set(a.mgr.session_ids()), "batch must stay local"
+        snap = a.mgr.snapshot(sid)
+        assert snap["generation"] == 4
+        assert np.array_equal(_grid_of(snap), _oracle(16, 16, 3, 4))
+        assert sid not in set(b.mgr.session_ids())
+    finally:
+        a.close()
+        b.close()
+
+
+# -------------------------------------------------------- real SIGKILL
+
+
+def _wait_for_serving(proc):
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError("server exited before announcing its port")
+        if "serving on http://" in line:
+            addr = line.split("http://", 1)[1].split(" ", 1)[0]
+            host, port = addr.rsplit(":", 1)
+            return host, int(port)
+    raise AssertionError("server never announced its port")
+
+
+def _http(host, port, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_sigkill_with_journal_and_torn_tail_restores_last_durable(tmp_path):
+    """SIGKILL a journaling server mid-run, then mangle the journal tail
+    the way an interrupted append would: the restarted server restores
+    the exact last durable generation and continues on the oracle."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = [sys.executable, "-m", "mpi_tpu.cli", "serve", "--port", "0",
+            "--state-dir", str(tmp_path), "--checkpoint-every", "1"]
+    k, m = 5, 3
+    p1 = subprocess.Popen(args, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, text=True, env=env)
+    try:
+        host, port = _wait_for_serving(p1)
+        sid = _http(host, port, "POST", "/sessions",
+                    {"rows": 24, "cols": 24, "backend": "serial",
+                     "seed": 17})["id"]
+        for _ in range(k):
+            _http(host, port, "POST", f"/sessions/{sid}/step", {"steps": 1})
+    finally:
+        p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=30)
+        p1.stdout.close()
+
+    jpath = tmp_path / f"{sid}.journal"
+    assert jpath.exists(), "checkpoint-every=1 must journal step commits"
+    with open(jpath, "ab") as f:
+        f.write(b"GOLJ\x01\x02half-an-entry")      # the torn append
+
+    p2 = subprocess.Popen(args, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, text=True, env=env)
+    try:
+        host, port = _wait_for_serving(p2)
+        assert _http(host, port, "GET", "/healthz")["restored_sessions"] == 1
+        snap = _http(host, port, "GET", f"/sessions/{sid}/snapshot")
+        assert snap["generation"] == k, "torn tail may cost only the tail"
+        for _ in range(m):
+            _http(host, port, "POST", f"/sessions/{sid}/step", {"steps": 1})
+        snap = _http(host, port, "GET", f"/sessions/{sid}/snapshot")
+        assert np.array_equal(_grid_of(snap), _oracle(24, 24, 17, k + m))
+    finally:
+        p2.kill()
+        p2.wait(timeout=30)
+        p2.stdout.close()
+
+
+# ------------------------------------------------------------ bench
+
+
+def test_bench_serve_durability_smoke():
+    """The A/B harness holds at a small board: gates pass, both byte
+    kinds counted, one parseable JSON line."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--serve-durability", "128", "4"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out.get("error") is None
+    assert out["ok"] is True, out
+    assert out["plan"] == "journal" and out["value"] > 0
+    assert out["gate_bytes_ok"] and out["gate_overhead_ok"]
+    assert out["gate_restore_parity_ok"]

@@ -14,6 +14,8 @@
 #   BENCH_GATE=1 tools/ci_gate.sh    # + bench envelope gate (hardware
 #                                    #   boxes; XLA:CPU runs --dry-run
 #                                    #   envelope-parse mode only)
+#   STATE_SCRUB=/path tools/ci_gate.sh  # + offline state-dir scrub
+#                                    #   (verify-only) over that dir
 #
 set -u
 cd "$(dirname "$0")/.."
@@ -62,6 +64,16 @@ if [ "${BENCH_GATE:-0}" = "1" ]; then
         # shellcheck disable=SC2086
         python tools/bench_gate.py --dry-run ${BENCH_GATE_ARGS:-}
     fi
+    track $?
+fi
+
+# Off by default: most CI boxes have no state dir to scrub.  Point
+# STATE_SCRUB at a serve --state-dir (e.g. a persistent volume carried
+# between runs) to CRC-verify every record and journal in it.
+if [ -n "${STATE_SCRUB:-}" ] && [ "${STATE_SCRUB}" != "0" ]; then
+    note "state scrub (tools/scrub.py ${STATE_SCRUB} ${SCRUB_ARGS:-})"
+    # shellcheck disable=SC2086
+    python tools/scrub.py "${STATE_SCRUB}" ${SCRUB_ARGS:-}
     track $?
 fi
 

@@ -51,6 +51,10 @@ order:
    counts), and after the kill the survivors flag the victim
    ``partial`` (its stale snapshot stays in ``by_node`` only until the
    membership machine confirms death and tombstones the peer away).
+7. **offline scrub** (ISSUE 18) — after the chaos group is quiesced,
+   ``tools/scrub.py`` runs the disaster-recovery runbook over the
+   shared state dir: verify, ``--repair`` whatever the SIGKILL tore,
+   then verify clean — the dir must come out adoptable.
 
 Exit-code contract (shared with the other ``tools/ci_gate.sh`` stages):
 0 clean, 1 findings, 2 internal error.  Needs jax only inside the
@@ -696,6 +700,51 @@ def main() -> int:
         check(bool(_poll(10.0, _adoptions_counted)),
               f"survivors' failover.adopted counters total exactly "
               f"{len(orphans)} (each orphan adopted once, none twice)")
+
+        # -- 5d: offline scrub of the post-SIGKILL state dir -------------
+        # Quiesce the survivors first (scrub repairs are only safe on a
+        # dir nobody is appending to), then the runbook: verify ->
+        # repair if needed -> verify clean.  A SIGKILL mid-append is
+        # allowed to leave a torn journal tail; it is NOT allowed to
+        # leave anything --repair cannot make adoptable again.
+        print("stage 5d: offline scrub after SIGKILL")
+        for p in chaos:
+            if p.poll() is None:
+                p.terminate()
+        for p in chaos:
+            try:
+                p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+        scrub = [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scrub.py")]
+        first = subprocess.run(scrub + [state_dir],
+                               capture_output=True, text=True,
+                               timeout=60)
+        check(first.returncode in (0, 1),
+              f"scrub verify exits 0/1, not {first.returncode} "
+              f"({first.stderr[-500:]!r})")
+        if first.returncode == 1:
+            print("  scrub found issues (expected after SIGKILL); "
+                  "repairing")
+            rep = subprocess.run(scrub + [state_dir, "--repair"],
+                                 capture_output=True, text=True,
+                                 timeout=60)
+            check(rep.returncode == 0,
+                  f"scrub --repair makes the dir adoptable (exit "
+                  f"{rep.returncode}: {rep.stdout[-500:]})")
+        final = subprocess.run(scrub + [state_dir, "--json"],
+                               capture_output=True, text=True,
+                               timeout=60)
+        check(final.returncode == 0,
+              f"post-repair scrub verifies clean (exit "
+              f"{final.returncode}: {final.stdout[-500:]})")
+        if final.returncode == 0:
+            rpt = json.loads(final.stdout)
+            check(rpt["records_ok"] > 0,
+                  "scrub saw the adopted records (records_ok > 0)")
 
     except Exception as e:                                # noqa: BLE001
         print(f"cluster_smoke: internal error: {type(e).__name__}: {e}",

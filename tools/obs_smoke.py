@@ -712,6 +712,25 @@ def main():
             if "sig" not in labels or not v > 0:
                 raise ValueError(f"roofline_efficiency sample malformed: "
                                  f"{labels} = {v}")
+        # -- durable state plane (ISSUE 18): this server persists with
+        # checkpoint_every=1, so both checkpoint byte kinds moved real
+        # bytes (full records at create/board-write, journal entries per
+        # committed step), nothing was quarantined, and the persistence
+        # state machine reads closed (0) on a healthy disk
+        for kind in ("full", "delta"):
+            moved = sum(v for n, labels, v in samples
+                        if n == "mpi_tpu_checkpoint_bytes_total"
+                        and labels.get("kind") == kind)
+            if moved <= 0:
+                raise ValueError(f"mpi_tpu_checkpoint_bytes_total"
+                                 f"{{kind={kind}}} counted no bytes")
+        if vals.get("mpi_tpu_persistence_state") != 0:
+            raise ValueError(f"mpi_tpu_persistence_state = "
+                             f"{vals.get('mpi_tpu_persistence_state')} on "
+                             f"a healthy disk, expected 0 (closed)")
+        if vals.get("mpi_tpu_state_records_corrupt_total", 0) != 0:
+            raise ValueError("state_records_corrupt_total rang on a "
+                             "clean state dir")
     finally:
         server.shutdown()
         server.server_close()

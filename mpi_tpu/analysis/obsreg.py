@@ -60,6 +60,10 @@ SLO_MODULES = ("mpi_tpu/obs/slo.py", "mpi_tpu/obs/timeseries.py")
 # families registered only when --admission/--tenants-file arms the
 # admission layer (ISSUE 16) — same armed-only discipline as SLO_MODULES
 ADMISSION_PREFIX = "mpi_tpu/admission/"
+# families registered only when --flight-recorder/--anomaly-detect arm
+# the flight plane (ISSUE 19) — same armed-only discipline
+FLIGHT_MODULES = ("mpi_tpu/obs/flight.py", "mpi_tpu/obs/devmem.py",
+                  "mpi_tpu/obs/anomaly.py")
 
 _BACKTICK = re.compile(r"`([^`]+)`")
 _FAMILY_TOKEN = re.compile(r"^mpi_tpu_[a-z0-9_{},*]+$")
@@ -157,15 +161,18 @@ def required_families(registry: Optional[dict] = None) -> Tuple[List[str],
     ``--peers`` and belong to neither list (see
     :func:`cluster_families`); likewise the ``SLO_MODULES`` families
     exist only when ``--telemetry-interval-s`` arms the sampler (see
-    :func:`slo_families`) and the ``ADMISSION_PREFIX`` families only
+    :func:`slo_families`), the ``ADMISSION_PREFIX`` families only
     when ``--admission``/``--tenants-file`` arms admission control
-    (see :func:`admission_families`)."""
+    (see :func:`admission_families`), and the ``FLIGHT_MODULES``
+    families only when ``--flight-recorder``/``--anomaly-detect`` arm
+    the flight plane (see :func:`flight_families`)."""
     registry = registry or extract_registry()
     core, aio = [], []
     for name, info in sorted(registry["metrics"].items()):
         if info["module"].startswith("mpi_tpu/cluster/") \
                 or info["module"].startswith(ADMISSION_PREFIX) \
-                or info["module"] in SLO_MODULES:
+                or info["module"] in SLO_MODULES \
+                or info["module"] in FLIGHT_MODULES:
             continue
         (aio if info["module"] == "mpi_tpu/serve/aio.py" else core).append(name)
     return core, aio
@@ -198,6 +205,17 @@ def admission_families(registry: Optional[dict] = None) -> List[str]:
     registry = registry or extract_registry()
     return sorted(name for name, info in registry["metrics"].items()
                   if info["module"].startswith(ADMISSION_PREFIX))
+
+
+def flight_families(registry: Optional[dict] = None) -> List[str]:
+    """Families registered by the flight-plane modules — present on a
+    scrape only when ``--flight-recorder``/``--anomaly-detect`` arm the
+    recorder (devmem additionally needs telemetry armed).  The runtime
+    smoke pins them ABSENT on an unarmed scrape (the default-off purity
+    gate) and present on an armed one."""
+    registry = registry or extract_registry()
+    return sorted(name for name, info in registry["metrics"].items()
+                  if info["module"] in FLIGHT_MODULES)
 
 
 # -- README cross-check ---------------------------------------------------

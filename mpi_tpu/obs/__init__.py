@@ -115,6 +115,11 @@ class Obs:
         # arm_telemetry() — the unarmed scrape/trace stay byte-identical
         self.telemetry = None
         self.slo = None
+        # flight recorder + drift detector + devmem sampler (ISSUE 19):
+        # None until arm_flight() — same unarmed byte-identity contract
+        self.flight = None
+        self.anomaly = None
+        self.devmem = None
         self.dispatch_solo = self.dispatch_latency.series(mode="solo")
         self.dispatch_batched = self.dispatch_latency.series(mode="batched")
         self.dispatch_host = self.dispatch_latency.series(mode="host")
@@ -174,6 +179,67 @@ class Obs:
         if start:
             tel.start()
         return tel
+
+    # -- flight recorder + anomaly profiling (ISSUE 19) ------------------
+
+    def arm_flight(self, capacity: int = 1024, manager=None,
+                   anomaly: bool = False,
+                   profile_dir: Optional[str] = None,
+                   devmem: bool = True, halo_probe: bool = True,
+                   clock=None, **anomaly_kw):
+        """Construct the per-dispatch flight recorder behind
+        ``--flight-recorder`` (plus the drift detector behind
+        ``--anomaly-detect`` and, when telemetry is already armed, the
+        device-memory sampler).  Idempotent.  Call AFTER
+        ``arm_telemetry`` — the devmem sample and the anomaly
+        evaluation chain onto the telemetry ticker; without telemetry,
+        tests drive ``anomaly.evaluate`` by hand."""
+        if self.flight is not None:
+            return self.flight
+        from mpi_tpu.obs.flight import FlightRecorder
+
+        fl = FlightRecorder(capacity=capacity, obs=self)
+        fl.bind_metrics(self.metrics)
+        self.flight = fl
+        kw = {} if clock is None else {"clock": clock}
+        if anomaly:
+            from mpi_tpu.obs.anomaly import AnomalyDetector
+
+            an = AnomalyDetector(self, profile_dir=profile_dir,
+                                 **kw, **anomaly_kw)
+            an.bind_metrics(self.metrics)
+            self.anomaly = an
+            fl.on_record = an.observe
+        tel = self.telemetry
+        if tel is not None:
+            if devmem:
+                from mpi_tpu.obs.devmem import DevMemSampler
+
+                dm = DevMemSampler(self, manager=manager,
+                                   halo_probe=halo_probe, **kw)
+                dm.bind_metrics(self.metrics)
+                self.devmem = dm
+                tel.add_series("device_memory_bytes", "gauge",
+                               dm.memory_total)
+                if manager is not None:
+                    tel.add_series(
+                        "engine_cache_entries", "gauge",
+                        lambda: (lambda st: st["size"]
+                                 + st["batched"]["size"])(
+                                     manager.cache.stats()))
+            prev = tel.after_sample
+            dm_, an_ = self.devmem, self.anomaly
+
+            def _chain(now):
+                if prev is not None:
+                    prev(now)
+                if dm_ is not None:
+                    dm_.sample(now)
+                if an_ is not None:
+                    an_.evaluate(now)
+
+            tel.after_sample = _chain
+        return fl
 
     # -- manager binding -------------------------------------------------
 
@@ -427,6 +493,12 @@ class Obs:
         out = {"trace": self.tracer.stats()}
         if self.telemetry is not None:
             out["telemetry"] = self.telemetry.stats()
+        if self.flight is not None:
+            out["flight"] = self.flight.stats()
+        if self.anomaly is not None:
+            out["anomaly"] = self.anomaly.stats()
+        if self.devmem is not None:
+            out["devmem"] = self.devmem.stats()
         return out
 
     def close(self) -> None:

@@ -1,0 +1,230 @@
+"""Device memory + compile-cache telemetry (ISSUE 19 tentpole, part 2).
+
+On real TPUs the question after "where did the time go" is "where did
+the HBM go": a leaked donated buffer or an engine-cache blowup shows up
+as an OOM generations later, far from the cause.  This sampler rides
+the PR-15 telemetry ticker (chained on ``TelemetryRecorder.
+after_sample``) and, once per tick:
+
+* reads ``jax.local_devices()`` memory stats per device — ``in_use`` /
+  ``limit`` / ``peak`` where the runtime reports them (TPU/GPU), with a
+  ``live_arrays`` fallback (sum of addressable-shard nbytes) on
+  XLA:CPU where ``memory_stats()`` is None — exported as
+  ``mpi_tpu_device_memory_bytes{device,kind}`` and recorded into the
+  telemetry ring so ``/debug/timeseries`` can plot the trend;
+* records EngineCache / batched-stepper / tune-cache occupancy
+  (``mpi_tpu_engine_cache_entries{cache}`` reads the authoritative
+  ``OrderedDict`` sizes at scrape time — the no-shadow-counting rule);
+* times one ghost-ring exchange on the serving mesh through
+  :func:`mpi_tpu.parallel.step.make_halo_probe` (memoized per
+  mesh/shape, first compile call discarded, multi-device meshes only)
+  into ``mpi_tpu_halo_exchange_seconds{mesh}`` — the per-shard halo
+  seam the paper's scaling story lives or dies on.
+
+Armed-only: constructed by ``Obs.arm_flight`` when telemetry is armed;
+unarmed builds register none of these families.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from mpi_tpu.obs.metrics import LATENCY_BUCKETS
+
+__all__ = ["DevMemSampler", "read_device_memory"]
+
+
+def read_device_memory() -> Dict[Tuple[str, str], float]:
+    """``{(device_label, kind): bytes}`` across local devices.  Kinds:
+    ``in_use``/``limit``/``peak`` from the runtime's ``memory_stats()``
+    where available, else ``live_arrays`` (addressable-shard nbytes sum
+    — the XLA:CPU fallback, which has no allocator stats)."""
+    import jax
+
+    out: Dict[Tuple[str, str], float] = {}
+    bare = []
+    for d in jax.local_devices():
+        label = f"{d.platform}:{d.id}"
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without stats support
+            stats = None
+        if stats:
+            for src, kind in (("bytes_in_use", "in_use"),
+                              ("bytes_limit", "limit"),
+                              ("peak_bytes_in_use", "peak")):
+                if src in stats:
+                    out[(label, kind)] = float(stats[src])
+        else:
+            bare.append(label)
+    if bare:
+        acc = {lbl: 0.0 for lbl in bare}
+        try:
+            for arr in jax.live_arrays():
+                try:
+                    for sh in arr.addressable_shards:
+                        d = sh.device
+                        lbl = f"{d.platform}:{d.id}"
+                        if lbl in acc:
+                            acc[lbl] += sh.data.nbytes
+                except Exception:  # noqa: BLE001 — deleted mid-iteration
+                    continue
+        except Exception:  # noqa: BLE001
+            pass
+        for lbl, v in acc.items():
+            out[(lbl, "live_arrays")] = v
+    return out
+
+
+class DevMemSampler:
+    """One tick of device-memory + cache + halo telemetry.
+
+    ``sample(now)`` is chained after the SLO evaluation on the telemetry
+    ticker; a raising backend must not kill the sampler (errors are
+    counted, the tick survives).  The memory snapshot is held for the
+    scrape callbacks — sampling at scrape time would put a
+    ``live_arrays`` walk on every ``/metrics`` GET.
+    """
+
+    def __init__(self, obs, manager=None, halo_probe: bool = True,
+                 probe_tile: int = 128,
+                 clock: Callable[[], float] = time.monotonic):
+        self._obs = obs
+        self._manager = manager
+        self._halo_enabled = halo_probe
+        self._probe_tile = int(probe_tile)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._mem: Dict[Tuple[str, str], float] = {}
+        self._samples = 0
+        self._errors = 0
+        # memoized probe: (mesh key) -> (probe fn, operand, warmed)
+        self._probe_key = None
+        self._probe = None
+        self.halo_hist = obs.metrics.histogram(
+            "mpi_tpu_halo_exchange_seconds",
+            "Wall time of one probed ghost-ring exchange on the serving "
+            "mesh (armed only: --flight-recorder + telemetry)",
+            LATENCY_BUCKETS)
+
+    # -- sampling --------------------------------------------------------
+
+    def sample(self, now: Optional[float] = None) -> None:
+        try:
+            mem = read_device_memory()
+            with self._lock:
+                self._mem = mem
+                self._samples += 1
+        except Exception:  # noqa: BLE001 — the ticker must outlive jax
+            with self._lock:
+                self._errors += 1
+            return
+        if self._halo_enabled:
+            try:
+                self._probe_halo()
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    self._errors += 1
+
+    def memory_total(self, kind: str = "in_use") -> float:
+        """Summed bytes across devices for one kind, with the
+        ``live_arrays`` fallback when the allocator kind is absent —
+        the telemetry-ring series feed."""
+        with self._lock:
+            mem = dict(self._mem)
+        total = sum(v for (_, k), v in mem.items() if k == kind)
+        if total == 0.0 and kind == "in_use":
+            total = sum(v for (_, k), v in mem.items()
+                        if k == "live_arrays")
+        return total
+
+    # -- halo probe ------------------------------------------------------
+
+    def _serving_mesh(self):
+        """The first multi-device mesh among live engines, or None (a
+        1-device mesh exchanges with itself — nothing worth timing)."""
+        mgr = self._manager
+        if mgr is None:
+            return None, None
+        from mpi_tpu.obs.profile import _live_engines
+
+        for e in _live_engines(mgr):
+            mesh = getattr(e, "mesh", None)
+            if mesh is not None and mesh.devices.size > 1:
+                return mesh, getattr(e.config, "boundary", "periodic")
+        return None, None
+
+    def _probe_halo(self) -> None:
+        mesh, boundary = self._serving_mesh()
+        if mesh is None:
+            return
+        key = (tuple(mesh.shape.items()), boundary,
+               tuple(d.id for d in mesh.devices.flat))
+        if key != self._probe_key:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from mpi_tpu.parallel.mesh import AXES
+            from mpi_tpu.parallel.step import make_halo_probe
+
+            ni = mesh.shape.get(AXES[0], 1)
+            nj = mesh.shape.get(AXES[1], 1)
+            operand = jax.device_put(
+                jnp.zeros((ni * self._probe_tile, nj * self._probe_tile),
+                          dtype=jnp.uint8),
+                NamedSharding(mesh, PartitionSpec(*AXES)))
+            fn = make_halo_probe(mesh, boundary)
+            # warm the compile outside the timed window: the first call
+            # is XLA wall, not halo wall
+            fn(operand).block_until_ready()
+            label = "x".join(str(mesh.shape[a]) for a in sorted(mesh.shape))
+            self._probe_key = key
+            self._probe = (fn, operand, self.halo_hist.series(mesh=label))
+        fn, operand, series = self._probe
+        t0 = time.perf_counter()
+        fn(operand).block_until_ready()
+        series.observe(time.perf_counter() - t0)
+
+    # -- readouts --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"samples": self._samples, "errors": self._errors,
+                    "devices": len({d for d, _ in self._mem}),
+                    "halo_probe": self._halo_enabled}
+
+    # -- armed-only registry families ------------------------------------
+
+    def bind_metrics(self, m) -> None:
+        def _mem_series():
+            with self._lock:
+                mem = dict(self._mem)
+            return [({"device": dev, "kind": kind}, v)
+                    for (dev, kind), v in sorted(mem.items())]
+
+        m.gauge_fn("mpi_tpu_device_memory_bytes",
+                   "Per-device memory by kind (in_use/limit/peak from "
+                   "the allocator, live_arrays on backends without "
+                   "stats)",
+                   _mem_series)
+
+        def _cache_entries():
+            mgr = self._manager
+            if mgr is None:
+                return []
+            st = mgr.cache.stats()
+            out = [({"cache": "engine"}, st["size"]),
+                   ({"cache": "batched"}, st["batched"]["size"])]
+            tc = getattr(mgr, "tune_cache", None)
+            if tc is not None:
+                out.append(({"cache": "tune"},
+                            len(getattr(tc, "_entries", ()))))
+            return out
+
+        m.gauge_fn("mpi_tpu_engine_cache_entries",
+                   "Compiled-engine, batched-stepper, and tune-cache "
+                   "occupancy (authoritative sizes read at scrape time)",
+                   _cache_entries)

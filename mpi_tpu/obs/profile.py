@@ -38,14 +38,27 @@ def run_profile(logdir: str, secs: float) -> Dict:
     try:
         import jax
 
-        jax.profiler.start_trace(logdir)
+        try:
+            jax.profiler.start_trace(logdir)
+        except Exception as e:  # noqa: BLE001
+            # a start_trace that raises partway (bad logdir, a capture
+            # started out-of-band) can leave JAX's process-global
+            # profiler half-armed; best-effort stop so the NEXT capture
+            # is not refused for the process lifetime
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — nothing was started
+                pass
+            return {"ok": False, "log_dir": logdir,
+                    "error": f"{type(e).__name__}: {e}"}
         try:
             time.sleep(secs)
         finally:
             jax.profiler.stop_trace()
         return {"ok": True, "log_dir": logdir, "seconds": secs}
     except Exception as e:  # noqa: BLE001 — profiling must not 500 the server
-        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        return {"ok": False, "log_dir": logdir,
+                "error": f"{type(e).__name__}: {e}"}
     finally:
         _profile_lock.release()
 

@@ -194,6 +194,10 @@ class TelemetryRecorder:
         self._sample_errors = 0
         self._thread: Optional[threading.Thread] = None
         self._stop: Optional[threading.Event] = None
+        # extra sampled series registered by armed-only subsystems
+        # (ISSUE 19's devmem sampler): read on every tick alongside the
+        # fixed SERIES set
+        self._extra: List[Tuple[str, Callable[[], Optional[float]]]] = []
         # called after every sample with the sample time — the SLO
         # engine's evaluation piggybacks on the same cadence
         self.after_sample: Optional[Callable[[float], None]] = None
@@ -264,9 +268,32 @@ class TelemetryRecorder:
                     out[series] = v
         return out
 
+    def add_series(self, name: str, kind: str,
+                   read_fn: Callable[[], Optional[float]]) -> None:
+        """Register an extra sampled series (armed-only subsystems —
+        e.g. the devmem sampler's device-memory and cache-occupancy
+        feeds).  ``read_fn`` returns the current value, or None to skip
+        the tick.  ``KINDS`` is copied onto the instance on first use so
+        the class schema stays fixed."""
+        if kind not in ("counter", "gauge"):
+            raise ValueError(f"series kind must be counter|gauge, "
+                             f"got {kind!r}")
+        if self.KINDS is type(self).KINDS:
+            self.KINDS = dict(type(self).KINDS)
+        self.KINDS[name] = kind
+        self._extra.append((name, read_fn))
+
     def sample_once(self, now: Optional[float] = None) -> None:
         now = self._clock() if now is None else now
         vals = self._read_all()
+        for name, fn in self._extra:
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 — one sick provider must
+                self._sample_errors += 1  # not kill the tick
+                continue
+            if v is not None:
+                vals[name] = float(v)
         with self._lock:
             for name, v in vals.items():
                 ring = self._rings.get(name)

@@ -225,6 +225,24 @@ def make_sharded_stepper(
     return segmented_evolve(make_local, K)
 
 
+def make_halo_probe(mesh: Mesh, boundary: str, radius: int = 1, axes=AXES):
+    """A jitted program that performs ONE ghost-ring exchange and nothing
+    else — the observability layer's probe for the halo seam
+    (``obs/devmem.py``).  The real exchanges run inside the jitted
+    steppers where host-side timing cannot see them; this isolates the
+    same ``exchange_halo`` collective so its wall can be sampled on the
+    telemetry cadence.  Output keeps each shard's ghost-extended tile
+    (no reduction: nothing but the exchange is timed)."""
+    spec = PartitionSpec(*axes)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    def probe(local):
+        return exchange_halo(local, radius, boundary, axes)
+
+    return probe
+
+
 WORD_BITS = 32  # cells per packed uint32 word (ops.bitlife.WORD)
 
 

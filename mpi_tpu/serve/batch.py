@@ -311,6 +311,13 @@ class MicroBatcher:
                 "batched", engine.sig_label, t2 - t1,
                 [(e.session.id, steps, steps * e.session.config.cells,
                   per_flops) for e in group])
+            fl = obs.flight
+            if fl is not None:
+                fl.record("batched", engine=engine, steps=steps,
+                          batch=B, setup_s=t1 - t0, device_s=t2 - t1,
+                          sessions=[e.session.id for e in group],
+                          request_ids=[e.rid for e in group],
+                          links=links or None)
         for e, grid in zip(group, boards):
             s = e.session
             s.setup_s += t1 - t0

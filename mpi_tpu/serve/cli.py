@@ -162,9 +162,37 @@ def build_parser() -> argparse.ArgumentParser:
                    "control and multi-tenancy' for the schema); implies "
                    "--admission.  Unset with --admission: one unlimited "
                    "default tenant")
+    p.add_argument("--flight-recorder", action="store_true",
+                   help="arm the per-dispatch flight recorder: every "
+                   "committed dispatch leaves a bounded ring record "
+                   "(plan signature, engine kind, k-segment composition, "
+                   "batch riders, sparse rung, donation, timing split) "
+                   "served at GET /debug/flights and folded into crash "
+                   "dumps.  Unset: no ring exists and the scrape/trace "
+                   "output is byte-identical to pre-flight builds")
+    p.add_argument("--flight-capacity", type=int, default=1024,
+                   help="flight-record ring size (oldest records "
+                   "overwritten; one flight_drop trace event per full "
+                   "ring turn)")
+    p.add_argument("--anomaly-detect", action="store_true",
+                   help="arm per-signature dispatch-latency drift "
+                   "detection on the telemetry cadence (implies "
+                   "--flight-recorder, and --telemetry-interval-s 5 when "
+                   "that flag is unset): sustained 1m+5m median drift vs "
+                   "the 1h baseline emits a dispatch_anomaly trace "
+                   "event, serves GET /debug/anomalies, and — with "
+                   "--profile-dir — arms one bounded, cooldown-gated "
+                   "jax.profiler capture per episode")
+    p.add_argument("--anomaly-cooldown-s", type=float, default=600.0,
+                   help="minimum seconds between anomaly-armed profiler "
+                   "captures (never back-to-back)")
+    p.add_argument("--anomaly-retention", type=int, default=4,
+                   help="keep at most this many anomaly-* capture dirs "
+                   "under --profile-dir (oldest pruned first)")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="arm POST /debug/profile?secs=N: captures a "
-                   "jax.profiler device trace into DIR (off when unset)")
+                   "jax.profiler device trace into DIR (off when unset); "
+                   "also where --anomaly-detect rotates its captures")
     p.add_argument("--front", choices=("threaded", "aio"),
                    default="threaded",
                    help="HTTP front end: 'threaded' (stdlib thread-per-"
@@ -275,9 +303,16 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    flight_on = args.flight_recorder or args.anomaly_detect
+    if flight_on and obs is None:
+        print("error: --flight-recorder/--anomaly-detect need "
+              "observability (drop --no-obs)", file=sys.stderr)
+        return 2
     telemetry_s = args.telemetry_interval_s
-    if telemetry_s is None and args.slo_file:
-        telemetry_s = 5.0               # --slo-file implies arming
+    if telemetry_s is None and (args.slo_file or args.anomaly_detect):
+        # --slo-file implies arming; --anomaly-detect too — drift
+        # evaluation rides the sampler cadence
+        telemetry_s = 5.0
     if telemetry_s is not None and obs is None:
         print("error: --telemetry-interval-s/--slo-file need "
               "observability (drop --no-obs)", file=sys.stderr)
@@ -296,6 +331,16 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             slo_opts = {}
         obs.arm_telemetry(interval_s=telemetry_s, manager=manager,
                           objectives=objectives, **slo_opts)
+    if flight_on:
+        # after arm_telemetry: the devmem sampler and the drift
+        # evaluation chain onto the ticker, which must exist first
+        anomaly_kw = {}
+        if args.anomaly_detect:
+            anomaly_kw = {"cooldown_s": args.anomaly_cooldown_s,
+                          "retention": args.anomaly_retention}
+        obs.arm_flight(capacity=args.flight_capacity, manager=manager,
+                       anomaly=args.anomaly_detect,
+                       profile_dir=args.profile_dir, **anomaly_kw)
     admission_on = args.admission or bool(args.tenants_file)
     if admission_on and obs is None:
         print("error: --admission/--tenants-file need "
@@ -397,6 +442,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         extras.append("admission"
                       + (f" tenants-file {args.tenants_file}"
                          if args.tenants_file else " (default tenant)"))
+    if flight_on:
+        extras.append(f"flight {args.flight_capacity}"
+                      + (" anomaly" if args.anomaly_detect else ""))
     if args.profile_dir:
         extras.append(f"profile-dir {args.profile_dir}")
     if args.front != "threaded":

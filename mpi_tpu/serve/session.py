@@ -1177,6 +1177,7 @@ class SessionManager:
                     t2 - t1,
                     [(session.id, steps, steps * session.config.cells,
                       flops)])
+                sa = None
                 if session.engine.sparse_plan is not None:
                     # activity readout AFTER the sync (tiny tile-map
                     # reduce + fetch) — the span every sparse dispatch
@@ -1187,6 +1188,13 @@ class SessionManager:
                               active_fraction=round(
                                   sa["active_fraction"], 6),
                               mode=sa["mode"])
+                fl = obs.flight
+                if fl is not None:
+                    fl.record("unit" if unit else "solo",
+                              engine=session.engine, steps=steps,
+                              session=session.id, setup_s=t1 - t0,
+                              device_s=t2 - t1, block_s=t2 - td,
+                              sparse=sa)
             self._mark_dispatch_ok()
         else:
             t0 = time.perf_counter()
@@ -1210,6 +1218,10 @@ class SessionManager:
                     t1 - t0,
                     [(session.id, steps, steps * session.config.cells,
                       0.0)])
+                fl = obs.flight
+                if fl is not None:
+                    fl.record("host", steps=steps, session=session.id,
+                              device_s=t1 - t0)
         session.generation += steps
         self._checkpoint(session)
         self._notify_step(session)

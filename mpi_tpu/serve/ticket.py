@@ -569,6 +569,13 @@ class AsyncDispatcher:
                     [(s.id, t.remaining,
                       t.remaining * s.config.cells,
                       pbg * t.remaining) for t, s in live])
+                fl = obs.flight
+                if fl is not None:
+                    fl.record("unit_round", engine=engine, steps=chain,
+                              batch=B, device_s=t2 - t1,
+                              sessions=[s.id for _, s in live],
+                              request_ids=[t.rid for t, _ in live],
+                              links=links or None)
             per_board = (t2 - t1) / B
             for (t, s), grid in zip(live, boards):
                 adv = t.remaining       # cohort chains run to completion

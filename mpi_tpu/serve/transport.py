@@ -40,7 +40,9 @@ from __future__ import annotations
 import itertools
 import json
 import math
+import os
 import sys
+import tempfile
 import time
 import traceback
 from typing import Callable, List, Optional, Tuple
@@ -292,6 +294,10 @@ class AppCore:
             return "profile", None, None
         if parts == ["debug", "timeseries"]:
             return "timeseries", None, None
+        if parts == ["debug", "flights"]:
+            return "flights", None, None
+        if parts == ["debug", "anomalies"]:
+            return "anomalies", None, None
         if len(parts) == 3 and parts[:2] == ["debug", "trace"]:
             return "trace", parts[2], None      # parts[2] is the trace id
         if parts and parts[0] == "cluster":
@@ -397,6 +403,20 @@ class AppCore:
                     payload["trace_dump"] = dump
                     print(f"[mpi_tpu] request {rid}: trace dumped to "
                           f"{dump}", file=sys.stderr)
+                fl = obs.flight
+                if fl is not None:
+                    # the flight ring rides the same crash evidence:
+                    # the last N dispatches, attributed, land beside
+                    # the trace dump
+                    base = dump or os.path.join(
+                        tempfile.gettempdir(),
+                        f"mpi_tpu_trace_crash_{os.getpid()}.jsonl")
+                    fdump = base + ".flights.jsonl"
+                    try:
+                        fl.dump(fdump)
+                        payload["flight_dump"] = fdump
+                    except OSError:
+                        pass
             return json_response(500, payload)
 
     def _handle(self, req: Request, rid: int, obs, transport: str,
@@ -471,6 +491,24 @@ class AppCore:
             if kind == "slo":
                 return json_response(200, mgr.slo())
             return self._timeseries(req, obs.telemetry)
+        if kind in ("flights", "anomalies") and method == "GET":
+            # armed-only surfaces (ISSUE 19), same contract as /slo:
+            # --no-obs answers the structured 404; an instrumented-but-
+            # unarmed server answers a 404 naming the arming flag
+            if obs is None:
+                return json_response(404, {
+                    "error": "observability is disabled (--no-obs)"})
+            if kind == "flights":
+                if obs.flight is None:
+                    return json_response(404, {
+                        "error": "flight recorder is not armed "
+                                 "(--flight-recorder)"})
+                return self._flights(req, obs.flight)
+            if obs.anomaly is None:
+                return json_response(404, {
+                    "error": "anomaly detection is not armed "
+                             "(--anomaly-detect)"})
+            return json_response(200, obs.anomaly.snapshot())
         if kind == "profile" and method == "POST":
             return self._profile(req)
         if kind == "healthz" and method == "GET":
@@ -754,6 +792,40 @@ class AppCore:
             "window": window,
             "interval_s": tel.interval_s,
             "points": tel.points(name, WINDOW_S[window]),
+        })
+
+    # -- dispatch flight records (GET /debug/flights) ----------------------
+
+    def _flights(self, req: Request, flight) -> Response:
+        """``?session=&signature=&slower_than=&trace=&limit=`` over the
+        flight ring (oldest first after filtering).  ``trace`` matches a
+        record's own trace id or any of its batch-rider links."""
+        qs = parse_qs(urlsplit(req.path).query)
+        session = qs.get("session", [None])[0]
+        signature = qs.get("signature", [None])[0]
+        trace = qs.get("trace", [None])[0]
+        slower = qs.get("slower_than", [None])[0]
+        if slower is not None:
+            try:
+                slower = float(slower)
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"slower_than must be a number, got {slower!r}")
+        raw_limit = qs.get("limit", [None])[0]
+        limit = None
+        if raw_limit is not None:
+            try:
+                limit = int(raw_limit)
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"limit must be an int, got {raw_limit!r}")
+        records = flight.snapshot(session=session, signature=signature,
+                                  slower_than=slower, trace=trace,
+                                  limit=limit)
+        return json_response(200, {
+            "stats": flight.stats(),
+            "count": len(records),
+            "flights": records,
         })
 
     # -- distributed trace assembly (GET /debug/trace/<trace_id>) ----------

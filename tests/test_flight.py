@@ -1,0 +1,621 @@
+"""Flight recorder + anomaly-triggered profiling (ISSUE 19).
+
+Six contracts:
+
+* ring semantics — overwrite keeps the newest ``capacity`` records with
+  stats counting the overwritten tail, one ``flight_drop`` trace event
+  per full turn (not per overwrite);
+* record field parity — the engine facts a record derives (kind,
+  signature, donation, tuning, k-segment composition, sparse rung)
+  match the engine that ran the dispatch, for dense/fused/sparse solo
+  and for batched rounds with their rider lists;
+* drift detection under a fake clock — the rank-relative detector
+  fires on an injected latency step in BOTH directions, damps its
+  recovery over ``damp_evals`` calm evaluations, and stays quiet below
+  the baseline sample floor;
+* capture duty cycle — at most one profiler capture per cooldown
+  window (never back-to-back), retention pruning the oldest
+  ``anomaly-*`` dirs;
+* default-off purity — an armed-telemetry-but-unarmed-flight server
+  records nothing, scrapes none of the flight families, and answers
+  404s naming the arming flag on both debug endpoints;
+* end-to-end — a served session whose dispatches slow down mid-stream
+  via the fault DSL (``step:N+:delay``) produces one
+  ``dispatch_anomaly`` event, exactly one capture within the cooldown,
+  and ``/debug/flights?slower_than=`` rows attributing the slow
+  dispatches.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mpi_tpu.obs import Obs
+from mpi_tpu.obs.anomaly import AnomalyDetector
+from mpi_tpu.obs.flight import FlightRecorder, engine_kind
+from mpi_tpu.serve.cache import EngineCache
+from mpi_tpu.serve.httpd import make_server
+from mpi_tpu.serve.session import SessionManager
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Cfg:
+    def __init__(self, comm_every=1, boundary="closed"):
+        self.comm_every = comm_every
+        self.boundary = boundary
+
+
+class _FakeEngine:
+    """The attribute surface ``FlightRecorder.record`` derives from."""
+
+    def __init__(self, sig="64x64/tpu/test", sparse_plan=None, pad_bits=0,
+                 boundary="closed", used_pallas=False, donates=False,
+                 tuned=None, bitpacked=False, k=1):
+        self.sig_label = sig
+        self.sparse_plan = sparse_plan
+        self.pad_bits = pad_bits
+        self._used_pallas = used_pallas
+        self.donates_input = donates
+        self.tuned_plan = tuned
+        self.bitpacked = bitpacked
+        self.config = _Cfg(comm_every=k, boundary=boundary)
+
+
+# ------------------------------------------------ engine classification
+
+
+def test_engine_kind_classification():
+    assert engine_kind(_FakeEngine()) == "dense"
+    assert engine_kind(_FakeEngine(used_pallas=True)) == "fused"
+    assert engine_kind(
+        _FakeEngine(pad_bits=8, boundary="periodic")) == "seam"
+    assert engine_kind(_FakeEngine(sparse_plan=object())) == "sparse"
+    # sparse wins ties: the rung decides what actually runs
+    assert engine_kind(_FakeEngine(sparse_plan=object(), used_pallas=True,
+                                   pad_bits=8,
+                                   boundary="periodic")) == "sparse"
+
+
+# ------------------------------------------------ ring semantics
+
+
+def test_ring_overwrite_keeps_newest_and_counts_drops():
+    fl = FlightRecorder(capacity=4)
+    for i in range(10):
+        fl.record("solo", engine=_FakeEngine(), steps=i + 1)
+    assert fl.stats() == {"capacity": 4, "recorded": 10, "dropped": 6}
+    recs = fl.snapshot()
+    assert [r["seq"] for r in recs] == [6, 7, 8, 9]
+    assert [r["steps"] for r in recs] == [7, 8, 9, 10]
+    # every survivor converted to export form: wall clock, no mono
+    assert all("t_unix" in r and "t_mono" not in r for r in recs)
+
+
+def test_ring_wrap_emits_one_flight_drop_per_turn():
+    obs = Obs()
+    try:
+        fl = FlightRecorder(capacity=4, obs=obs)
+        for _ in range(9):          # seq 0..8: wraps at 4 and 8
+            fl.record("solo", engine=_FakeEngine())
+        drops = [r for r in obs.tracer.snapshot()
+                 if r["name"] == "flight_drop"]
+        assert [(d["dropped"], d["total"]) for d in drops] == \
+            [(4, 4), (4, 8)]
+    finally:
+        obs.close()
+
+
+def test_ring_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+# ------------------------------------------------ record field parity
+
+
+def test_record_parity_fused_engine():
+    fl = FlightRecorder(capacity=8)
+    eng = _FakeEngine(sig="512x512/tpu/fused", used_pallas=True, k=3,
+                      donates=True, tuned=object(), bitpacked=True)
+    rec = fl.record("solo", engine=eng, steps=7, session="s1",
+                    setup_s=0.5, device_s=0.25, block_s=0.125)
+    assert rec["engine"] == "fused"
+    assert rec["signature"] == eng.sig_label
+    assert rec["k"] == 3
+    assert rec["segments"] == {"full": 2, "rem": 1}
+    assert rec["donated"] and rec["tuned"] and rec["bitpacked"]
+    assert (rec["setup_s"], rec["device_s"], rec["block_s"]) == \
+        (0.5, 0.25, 0.125)
+
+
+def test_record_parity_sparse_stats_passed_never_recomputed():
+    fl = FlightRecorder(capacity=8)
+    eng = _FakeEngine(sparse_plan=object())
+    rec = fl.record("solo", engine=eng, steps=1, session="s1",
+                    sparse={"active_tiles": 5, "active_fraction": 0.125,
+                            "mode": "tile"})
+    assert rec["engine"] == "sparse"
+    assert rec["sparse"] == {"active_tiles": 5, "active_fraction": 0.125,
+                             "rung": "tile"}
+
+
+def test_record_parity_batched_riders():
+    fl = FlightRecorder(capacity=8)
+    rec = fl.record("batched", engine=_FakeEngine(), steps=4, batch=3,
+                    sessions=["a", "b", "c"], request_ids=[7, 8, 9],
+                    links=["ab" * 16 + ":" + "cd" * 8])
+    assert rec["batch"] == 3
+    assert rec["sessions"] == ["a", "b", "c"]
+    assert rec["request_ids"] == [7, 8, 9]
+    assert rec["links"] == ["ab" * 16 + ":" + "cd" * 8]
+
+
+def test_record_host_mode_has_no_engine_facts():
+    fl = FlightRecorder(capacity=8)
+    rec = fl.record("host", steps=3, session="s1", device_s=0.01)
+    assert rec["engine"] == "host"
+    assert "signature" not in rec and "k" not in rec
+
+
+def test_on_record_feed_gets_signature_and_wall():
+    fl = FlightRecorder(capacity=8)
+    seen = []
+    fl.on_record = lambda sig, wall, tid: seen.append((sig, wall, tid))
+    fl.record("solo", engine=_FakeEngine(sig="sigA"), steps=1,
+              device_s=0.25)
+    fl.record("host", steps=1, device_s=0.5)
+    assert seen == [("sigA", 0.25, None), (None, 0.5, None)]
+
+
+# ------------------------------------------------ snapshot filters
+
+
+def _filter_ring():
+    fl = FlightRecorder(capacity=16)
+    fl.record("solo", engine=_FakeEngine(sig="sigA"), steps=1,
+              session="s1", device_s=0.01)
+    fl.record("solo", engine=_FakeEngine(sig="sigB"), steps=1,
+              session="s2", device_s=0.20)
+    fl.record("batched", engine=_FakeEngine(sig="sigA"), steps=1, batch=2,
+              sessions=["s1", "s3"], device_s=0.05,
+              links=["f" * 32 + ":" + "0" * 16])
+    return fl
+
+
+def test_snapshot_filters():
+    fl = _filter_ring()
+    assert len(fl.snapshot()) == 3
+    # session matches the solo owner or any batch rider
+    assert [r["seq"] for r in fl.snapshot(session="s1")] == [0, 2]
+    assert [r["seq"] for r in fl.snapshot(session="s3")] == [2]
+    assert [r["seq"] for r in fl.snapshot(signature="sigA")] == [0, 2]
+    # slower_than is strict
+    assert [r["seq"] for r in fl.snapshot(slower_than=0.05)] == [1]
+    assert [r["seq"] for r in fl.snapshot(slower_than=0.04)] == [1, 2]
+    # trace matches a rider link by prefix (links are trace_id:span_id)
+    assert [r["seq"] for r in fl.snapshot(trace="f" * 32)] == [2]
+    assert fl.snapshot(trace="0" * 32) == []
+    # limit keeps the newest
+    assert [r["seq"] for r in fl.snapshot(limit=2)] == [1, 2]
+
+
+def test_dump_writes_export_form_jsonl(tmp_path):
+    fl = _filter_ring()
+    path = str(tmp_path / "ring.flights.jsonl")
+    assert fl.dump(path) == 3
+    lines = [json.loads(l) for l in
+             open(path, encoding="utf-8").read().splitlines()]
+    assert [r["seq"] for r in lines] == [0, 1, 2]
+    assert all("t_unix" in r for r in lines)
+
+
+# ------------------------------------------------ real dispatch parity
+
+
+def test_solo_dispatch_record_matches_engine():
+    obs = Obs()
+    try:
+        obs.arm_flight(capacity=8)
+        mgr = SessionManager(EngineCache(max_size=2), obs=obs,
+                             batching=False)
+        info = mgr.create({"rows": 16, "cols": 16, "backend": "tpu"})
+        mgr.step(info["id"], 3)
+        recs = obs.flight.snapshot()
+        assert len(recs) == 1
+        rec = recs[0]
+        eng = mgr.get(info["id"]).engine
+        assert rec["mode"] == "solo"
+        assert rec["session"] == info["id"]
+        assert rec["steps"] == 3
+        assert rec["signature"] == eng.sig_label
+        assert rec["engine"] == engine_kind(eng)
+        assert rec["k"] == int(getattr(eng.config, "comm_every", 1) or 1)
+        assert rec["device_s"] > 0.0 and rec["block_s"] >= 0.0
+    finally:
+        obs.close()
+
+
+# ------------------------------------------------ drift detection
+
+
+def _feed(det, clock, sig, wall, n, gap_s, tids=False):
+    """n observations of one wall time, clock advancing gap_s apiece."""
+    for i in range(n):
+        clock.t += gap_s
+        det.observe(sig, wall,
+                    f"{i:032x}" if tids else None)
+
+
+def _slow_drift(det, clock, sig="sig", tids=True):
+    """Baseline of fast dispatches aged out of the recent windows, then
+    a burst of 5x-slower ones inside them."""
+    _feed(det, clock, sig, 0.010, 40, 9.0)      # baseline: 360 s of 10 ms
+    clock.t += 301.0                            # age past the 5m window
+    _feed(det, clock, sig, 0.050, 16, 1.0, tids=tids)
+    det.evaluate(clock.t)
+
+
+def test_detector_fires_on_latency_step_and_damps_recovery(tmp_path):
+    obs = Obs()
+    clock = _FakeClock()
+    caps = []
+    try:
+        det = AnomalyDetector(obs, clock=clock, profile_dir=str(tmp_path),
+                              capture_fn=lambda d, s: caps.append(d))
+        _slow_drift(det, clock)
+        snap = det.snapshot()
+        assert snap["signatures"][0]["state"] == "slow"
+        assert len(snap["episodes"]) == 1
+        ep = snap["episodes"][0]
+        assert ep["direction"] == "slow"
+        assert ep["ratios"]["1m"] >= 2.0 and ep["ratios"]["5m"] >= 2.0
+        # exemplars: the slowest recent dispatches' trace ids, capped at 3
+        assert len(ep["exemplars"]) == 3
+        # the episode armed exactly one capture, in the rotated dir
+        assert len(caps) == 1
+        assert os.path.basename(caps[0]).startswith("anomaly-")
+        assert ep["capture_dir"] == caps[0]
+        events = [r for r in obs.tracer.snapshot()
+                  if r["name"] == "dispatch_anomaly"]
+        assert len(events) == 1
+        assert events[0]["direction"] == "slow"
+        assert events[0]["capture"] == caps[0]
+
+        # still slow: no re-emission, no second capture
+        det.evaluate(clock.t)
+        assert len(det.snapshot()["episodes"]) == 1
+        assert len(caps) == 1
+
+        # recovery: slow burst ages out, normal traffic returns — the
+        # state damps over damp_evals calm evaluations, silently
+        clock.t += 301.0
+        _feed(det, clock, "sig", 0.010, 16, 1.0)
+        for i in range(3):
+            det.evaluate(clock.t)
+            want = "slow" if i < 2 else "ok"
+            assert det.snapshot()["signatures"][0]["state"] == want
+        assert len(det.snapshot()["episodes"]) == 1
+        assert len([r for r in obs.tracer.snapshot()
+                    if r["name"] == "dispatch_anomaly"]) == 1
+    finally:
+        obs.close()
+
+
+def test_detector_fires_fast_direction_without_capture(tmp_path):
+    obs = Obs()
+    clock = _FakeClock()
+    caps = []
+    try:
+        det = AnomalyDetector(obs, clock=clock, profile_dir=str(tmp_path),
+                              capture_fn=lambda d, s: caps.append(d))
+        _feed(det, clock, "sig", 0.010, 40, 9.0)
+        clock.t += 301.0
+        _feed(det, clock, "sig", 0.002, 16, 1.0)    # suspicious speedup
+        det.evaluate(clock.t)
+        snap = det.snapshot()
+        assert snap["signatures"][0]["state"] == "fast"
+        assert snap["episodes"][0]["direction"] == "fast"
+        # captures are for regressions only: a fast anomaly never
+        # burns a profiler slot
+        assert caps == []
+        assert snap["anomalies_total"] == {"fast": 1}
+    finally:
+        obs.close()
+
+
+def test_detector_quiet_below_baseline_floor():
+    det = AnomalyDetector(None, clock=_FakeClock())
+    clock = det._clock
+    # 18 total baseline-window samples < min_baseline=32 (the recent
+    # burst counts toward the baseline too): even a 5x recent median
+    # must not ring
+    _feed(det, clock, "sig", 0.010, 10, 9.0)
+    clock.t += 301.0
+    _feed(det, clock, "sig", 0.050, 8, 1.0)
+    det.evaluate(clock.t)
+    assert det.snapshot()["signatures"][0]["state"] == "ok"
+    assert det.snapshot()["episodes"] == []
+
+
+def test_detector_ratio_must_exceed_one():
+    with pytest.raises(ValueError):
+        AnomalyDetector(None, ratio=1.0)
+
+
+# ------------------------------------------------ capture duty cycle
+
+
+def test_capture_cooldown_never_back_to_back(tmp_path):
+    obs = Obs()
+    clock = _FakeClock()
+    caps = []
+    try:
+        det = AnomalyDetector(obs, clock=clock, profile_dir=str(tmp_path),
+                              cooldown_s=1000.0,
+                              capture_fn=lambda d, s: caps.append(d))
+        _slow_drift(det, clock)
+        assert len(caps) == 1
+
+        # recover (3 calm evals), then drift again ~620 s later — still
+        # inside the cooldown: the episode rings but arms no capture
+        clock.t += 301.0
+        _feed(det, clock, "sig", 0.010, 16, 0.5)
+        for _ in range(3):
+            det.evaluate(clock.t)
+        clock.t += 301.0
+        _feed(det, clock, "sig", 0.050, 16, 0.5, tids=True)
+        det.evaluate(clock.t)
+        snap = det.snapshot()
+        assert len(snap["episodes"]) == 2
+        assert snap["episodes"][1]["capture_dir"] is None
+        assert len(caps) == 1
+
+        # a third drift past the cooldown arms again
+        clock.t += 301.0
+        _feed(det, clock, "sig", 0.010, 16, 0.5)
+        for _ in range(3):
+            det.evaluate(clock.t)
+        clock.t += 301.0
+        _feed(det, clock, "sig", 0.050, 16, 0.5, tids=True)
+        det.evaluate(clock.t)
+        assert len(caps) == 2
+        assert det.snapshot()["capture"]["captures"] == 2
+    finally:
+        obs.close()
+
+
+def test_capture_retention_prunes_oldest(tmp_path):
+    for stale in ("anomaly-20250101-000000-001",
+                  "anomaly-20250102-000000-002",
+                  "anomaly-20250103-000000-003"):
+        os.makedirs(tmp_path / stale)
+    det = AnomalyDetector(None, clock=_FakeClock(),
+                          profile_dir=str(tmp_path), cooldown_s=0.0,
+                          retention=2, capture_fn=lambda d, s: None)
+    path = det._maybe_capture(1000.0)
+    assert path is not None and os.path.isdir(path)
+    left = sorted(n for n in os.listdir(tmp_path)
+                  if n.startswith("anomaly-"))
+    # retention=2: the new capture plus the single newest survivor
+    assert len(left) == 2
+    assert os.path.basename(path) in left
+    assert "anomaly-20250103-000000-003" in left
+
+
+def test_capture_disarmed_without_profile_dir(tmp_path):
+    caps = []
+    obs = Obs()
+    clock = _FakeClock()
+    try:
+        det = AnomalyDetector(obs, clock=clock, profile_dir=None,
+                              capture_fn=lambda d, s: caps.append(d))
+        _slow_drift(det, clock)
+        snap = det.snapshot()
+        assert snap["episodes"][0]["direction"] == "slow"
+        assert snap["episodes"][0]["capture_dir"] is None
+        assert caps == []
+    finally:
+        obs.close()
+
+
+# ------------------------------------------------ default-off purity
+
+
+def _serve(manager):
+    server = make_server(port=0, manager=manager)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://{host}:{port}"
+
+
+def _call(base, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_unarmed_server_records_nothing_and_404s():
+    obs = Obs()
+    mgr = SessionManager(EngineCache(max_size=2), obs=obs, batching=False)
+    server, base = _serve(mgr)
+    try:
+        st, body = _call(base, "POST", "/sessions",
+                         {"rows": 16, "cols": 16, "backend": "tpu"})
+        assert st == 200
+        sid = json.loads(body)["id"]
+        st, _ = _call(base, "POST", f"/sessions/{sid}/step", {"steps": 2})
+        assert st == 200
+        assert obs.flight is None and obs.anomaly is None
+        # the scrape carries no flight-plane family, the trace no
+        # flight-plane kind — the unarmed surface is byte-identical
+        st, text = _call(base, "GET", "/metrics")
+        assert "mpi_tpu_flight" not in text
+        assert "mpi_tpu_anomaly" not in text
+        assert "mpi_tpu_dispatch_anomalies" not in text
+        assert "mpi_tpu_device_memory" not in text
+        kinds = {r["name"] for r in obs.tracer.snapshot()}
+        assert not kinds & {"flight_drop", "dispatch_anomaly"}
+        st, body = _call(base, "GET", "/debug/flights")
+        assert st == 404
+        assert "--flight-recorder" in json.loads(body)["error"]
+        st, body = _call(base, "GET", "/debug/anomalies")
+        assert st == 404
+        assert "--anomaly-detect" in json.loads(body)["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()
+
+
+def test_no_obs_server_404s_both_debug_endpoints():
+    mgr = SessionManager(EngineCache(max_size=2), obs=None, batching=False)
+    server, base = _serve(mgr)
+    try:
+        info = mgr.create({"rows": 16, "cols": 16, "backend": "tpu"})
+        mgr.step(info["id"], 1)         # --no-obs stepping still works
+        for path in ("/debug/flights", "/debug/anomalies"):
+            st, body = _call(base, "GET", path)
+            assert st == 404
+            assert "--no-obs" in json.loads(body)["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_armed_endpoint_filters_and_errors():
+    obs = Obs()
+    mgr = SessionManager(EngineCache(max_size=2), obs=obs, batching=False)
+    obs.arm_flight(capacity=8, manager=mgr, anomaly=True)
+    server, base = _serve(mgr)
+    try:
+        info = mgr.create({"rows": 16, "cols": 16, "backend": "tpu"})
+        for _ in range(3):
+            mgr.step(info["id"], 1)
+        st, body = _call(base, "GET", "/debug/flights")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["count"] == 3
+        assert all(r["session"] == info["id"] for r in doc["flights"])
+        st, body = _call(base, "GET", "/debug/flights?limit=1")
+        assert json.loads(body)["count"] == 1
+        st, body = _call(base, "GET",
+                         f"/debug/flights?session={info['id']}")
+        assert json.loads(body)["count"] == 3
+        st, body = _call(base, "GET", "/debug/flights?session=nope")
+        assert json.loads(body)["count"] == 0
+        st, body = _call(base, "GET", "/debug/flights?slower_than=abc")
+        assert st == 400
+        st, body = _call(base, "GET", "/debug/flights?limit=x")
+        assert st == 400
+        st, body = _call(base, "GET", "/debug/anomalies")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["windows_s"] == {"1m": 60.0, "5m": 300.0}
+        assert doc["capture"]["profile_dir"] is None
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()
+
+
+# ------------------------------------------------ end to end
+
+
+def test_e2e_latency_regression_rings_and_captures(tmp_path):
+    """The acceptance path: a served session's dispatches slow down
+    mid-stream (fault DSL ``step:41+:delay``), the detector rings one
+    ``dispatch_anomaly`` with exemplar trace ids, arms exactly one
+    capture within the cooldown, and ``/debug/flights`` attributes the
+    slow dispatches — only the clock is injected."""
+    obs = Obs()
+    clock = _FakeClock(5000.0)
+    caps = []
+    mgr = SessionManager(EngineCache(max_size=2), obs=obs, batching=False,
+                         faults="step:41+:delay:0.03")
+    tel = obs.arm_telemetry(interval_s=5.0, manager=mgr, clock=clock,
+                            start=False)
+    obs.arm_flight(capacity=64, manager=mgr, anomaly=True,
+                   profile_dir=str(tmp_path), devmem=False, clock=clock,
+                   capture_fn=lambda d, s: caps.append(d))
+    server, base = _serve(mgr)
+    try:
+        st, body = _call(base, "POST", "/sessions",
+                         {"rows": 16, "cols": 16, "backend": "tpu"})
+        assert st == 200
+        sid = json.loads(body)["id"]
+        for _ in range(40):                 # baseline: undelayed
+            clock.t += 9.0
+            st, _ = _call(base, "POST", f"/sessions/{sid}/step",
+                          {"steps": 1})
+            assert st == 200
+        clock.t += 301.0                    # age past the 5m window
+        for _ in range(16):                 # dispatch 41+: +30 ms each
+            clock.t += 1.0
+            st, _ = _call(base, "POST", f"/sessions/{sid}/step",
+                          {"steps": 1})
+            assert st == 200
+        tel.sample_once(clock.t)            # ticker: slo -> anomaly chain
+
+        events = [r for r in obs.tracer.snapshot()
+                  if r["name"] == "dispatch_anomaly"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["direction"] == "slow"
+        assert ev["ratios"]["1m"] >= 2.0 and ev["ratios"]["5m"] >= 2.0
+        # exemplars join back into the per-request distributed traces
+        assert 1 <= len(ev["exemplars"]) <= 3
+        assert all(len(t) == 32 for t in ev["exemplars"])
+        assert len(caps) == 1 and ev["capture"] == caps[0]
+
+        # a second tick inside the cooldown: state already slow, no
+        # re-emission, still exactly one capture
+        clock.t += 5.0
+        tel.sample_once(clock.t)
+        assert len([r for r in obs.tracer.snapshot()
+                    if r["name"] == "dispatch_anomaly"]) == 1
+        assert len(caps) == 1
+
+        # /debug/flights attributes the slow dispatches to the session
+        st, body = _call(base, "GET", "/debug/flights?slower_than=0.02")
+        doc = json.loads(body)
+        assert doc["count"] == 16
+        assert all(r["session"] == sid and r["device_s"] > 0.02
+                   and r["trace_id"] for r in doc["flights"])
+        # ...and the exemplars are real flight-record trace ids
+        ring_tids = {r["trace_id"] for r in doc["flights"]}
+        assert set(ev["exemplars"]) <= ring_tids
+
+        st, body = _call(base, "GET", "/debug/anomalies")
+        doc = json.loads(body)
+        assert doc["anomalies_total"] == {"slow": 1}
+        assert doc["capture"]["captures"] == 1
+        sigrows = {s["sig"]: s for s in doc["signatures"]}
+        sig = doc["episodes"][0]["sig"]
+        assert sigrows[sig]["state"] == "slow"
+
+        st, text = _call(base, "GET", "/metrics")
+        assert f'mpi_tpu_anomaly_state{{sig="{sig}"}} 2' in text
+        assert 'mpi_tpu_dispatch_anomalies_total{direction="slow"} 1' \
+            in text
+        assert "mpi_tpu_anomaly_captures_total 1" in text
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()

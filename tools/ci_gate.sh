@@ -14,6 +14,10 @@
 #   BENCH_GATE=1 tools/ci_gate.sh    # + bench envelope gate (hardware
 #                                    #   boxes; XLA:CPU runs --dry-run
 #                                    #   envelope-parse mode only)
+#   FLIGHT_GATE=1 tools/ci_gate.sh   # + flight-plane overhead gate
+#                                    #   (bench.py --serve-flight, <2%
+#                                    #   paired-median; wall-clock —
+#                                    #   arm on quiet boxes only)
 #   STATE_SCRUB=/path tools/ci_gate.sh  # + offline state-dir scrub
 #                                    #   (verify-only) over that dir
 #
@@ -64,6 +68,20 @@ if [ "${BENCH_GATE:-0}" = "1" ]; then
         # shellcheck disable=SC2086
         python tools/bench_gate.py --dry-run ${BENCH_GATE_ARGS:-}
     fi
+    track $?
+fi
+
+# Off by default for the same reason as BENCH_GATE: a paired-median
+# wall-clock measurement belongs on a quiet box.  FLIGHT_GATE=1 runs
+# the ISSUE 19 armed-vs-unarmed overhead gate (<2% or exit 1 via the
+# bench's "ok" field).
+if [ "${FLIGHT_GATE:-0}" = "1" ]; then
+    note "flight overhead gate (bench.py --serve-flight)"
+    python bench.py --serve-flight | python -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+print(json.dumps(doc, indent=2))
+sys.exit(0 if doc.get("ok") else 1)'
     track $?
 fi
 

@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""Browse dispatch flight records (stdlib only).
+
+Two sources, one renderer — the ``trace_view.py`` pattern:
+
+* live — ``flight_view.py --url http://host:port`` asks the serving
+  front's ``GET /debug/flights`` (filters pass through as query
+  params, so the ring is filtered server-side);
+* ``--from-jsonl dump.flights.jsonl`` — offline over a crash dump's
+  flight fold (``<trace_dump>.flights.jsonl``) with the same filters
+  applied locally.
+
+Output is one table row per dispatch: mode, engine kind, signature,
+steps and k-segment composition, batch riders, device/block wall, and
+the trace linkage — plus a per-signature summary so "which plan got
+slow" answers itself.  ``--slower-than 0.05`` narrows either source to
+the dispatches worth staring at.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fetch(url: str, filters: dict) -> dict:
+    qs = urllib.parse.urlencode(
+        {k: v for k, v in filters.items() if v is not None})
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/debug/flights" + (f"?{qs}" if qs else ""))
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def from_jsonl(path: str, filters: dict) -> dict:
+    """Apply the endpoint's filter semantics to a dumped flight ring."""
+    recs = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue        # half-written tail line: skip, not fail
+    session = filters.get("session")
+    signature = filters.get("signature")
+    slower = filters.get("slower_than")
+    trace = filters.get("trace")
+    out = []
+    for r in recs:
+        if session is not None and (
+                r.get("session") != session
+                and session not in (r.get("sessions") or ())):
+            continue
+        if signature is not None and r.get("signature") != signature:
+            continue
+        if slower is not None and r.get("device_s", 0.0) <= slower:
+            continue
+        if trace is not None and not (
+                r.get("trace_id") == trace
+                or any(ln.startswith(trace)
+                       for ln in (r.get("links") or ()))):
+            continue
+        out.append(r)
+    limit = filters.get("limit")
+    if limit is not None:
+        out = out[-limit:]
+    return {"stats": {"recorded": len(recs)}, "count": len(out),
+            "flights": out}
+
+
+def _fmt_dur(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def render(payload: dict, verbose: bool = False) -> str:
+    recs = payload.get("flights") or []
+    st = payload.get("stats") or {}
+    out = [f"{len(recs)} flight record(s) shown · ring recorded "
+           f"{st.get('recorded', '?')} dropped {st.get('dropped', 0)}"]
+    if not recs:
+        out.append("  (no records match)")
+        return "\n".join(out)
+    out.append(f"{'seq':>6} {'mode':<10} {'engine':<7} {'sig':<24} "
+               f"{'steps':>6} {'k':>3} {'B':>3} {'setup':>9} "
+               f"{'device':>9} {'block':>9} flags")
+    per_sig: dict = {}
+    for r in recs:
+        flags = "".join((
+            "d" if r.get("donated") else "-",
+            "t" if r.get("tuned") else "-",
+            "b" if r.get("bitpacked") else "-",
+        ))
+        sig = str(r.get("signature", "-"))
+        out.append(
+            f"{r.get('seq', 0):>6} {r.get('mode', '?'):<10} "
+            f"{r.get('engine', '?'):<7} {sig[:24]:<24} "
+            f"{r.get('steps', 0):>6} {r.get('k', 1):>3} "
+            f"{r.get('batch') or 1:>3} "
+            f"{_fmt_dur(r.get('setup_s', 0.0)):>9} "
+            f"{_fmt_dur(r.get('device_s', 0.0)):>9} "
+            f"{_fmt_dur(r.get('block_s', 0.0)):>9} {flags}")
+        if verbose:
+            seg = r.get("segments")
+            detail = []
+            if seg:
+                detail.append(f"segments full={seg.get('full')} "
+                              f"rem={seg.get('rem')}")
+            sp = r.get("sparse")
+            if sp:
+                detail.append(f"sparse rung={sp.get('rung')} "
+                              f"tiles={sp.get('active_tiles')} "
+                              f"frac={sp.get('active_fraction')}")
+            sids = r.get("session") or ",".join(r.get("sessions") or ())
+            if sids:
+                detail.append(f"session(s)={sids}")
+            if r.get("trace_id"):
+                detail.append(f"trace={r['trace_id']}")
+            if r.get("links"):
+                detail.append(f"links={len(r['links'])}")
+            if detail:
+                out.append("       " + " · ".join(detail))
+        agg = per_sig.setdefault(sig, [0, 0.0, 0.0])
+        agg[0] += 1
+        agg[1] += r.get("device_s", 0.0)
+        agg[2] = max(agg[2], r.get("device_s", 0.0))
+    out.append("per signature:")
+    for sig, (n, tot, worst) in sorted(per_sig.items()):
+        out.append(f"  {sig[:40]:<40} n={n:<5} "
+                   f"mean={_fmt_dur(tot / n):>9} "
+                   f"worst={_fmt_dur(worst):>9}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="browse per-dispatch flight records")
+    ap.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="serving front to query (GET /debug/flights)")
+    ap.add_argument("--from-jsonl", dest="from_jsonl", metavar="PATH",
+                    default=None,
+                    help="read a dumped flight ring (crash-dump "
+                         "*.flights.jsonl) instead of fetching")
+    ap.add_argument("--session", default=None,
+                    help="only records for this session id (rider "
+                         "membership counts)")
+    ap.add_argument("--signature", default=None,
+                    help="only records for this plan signature label")
+    ap.add_argument("--slower-than", type=float, default=None,
+                    metavar="SECS",
+                    help="only records with device_s above SECS")
+    ap.add_argument("--trace", default=None,
+                    help="only records referencing this trace id "
+                         "(own trace or rider link)")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="keep only the newest N matching records")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="per-record segment/sparse/linkage detail rows")
+    args = ap.parse_args(argv)
+    filters = {"session": args.session, "signature": args.signature,
+               "slower_than": args.slower_than, "trace": args.trace,
+               "limit": args.limit}
+    try:
+        payload = (from_jsonl(args.from_jsonl, filters)
+                   if args.from_jsonl else fetch(args.url, filters))
+    except urllib.error.HTTPError as e:
+        print(f"error: {args.url} answered {e.code}: "
+              f"{e.read().decode(errors='replace')}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(render(payload, verbose=args.verbose))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,10 +47,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from mpi_tpu.analysis.obsreg import admission_families, cluster_families, \
-    required_families
+    flight_families, required_families
 
 # the metric families every scrape must expose (pre-registered or bound
 # at manager attach — present even before traffic touches a site), and
@@ -77,6 +78,17 @@ SLO_METRICS = ("mpi_tpu_slo_state", "mpi_tpu_slo_transitions_total",
 # required PRESENT on check_admission's armed scrape.  Extracted, not
 # hand-listed, like the cluster set
 ADMISSION_METRICS = tuple(admission_families())
+# families registered only when --flight-recorder/--anomaly-detect arm
+# the flight plane (ISSUE 19) — required ABSENT from the unarmed scrape,
+# required PRESENT on check_flight's armed scrape.  Extracted, not
+# hand-listed, like the cluster and admission sets
+FLIGHT_METRICS = tuple(flight_families())
+# span kinds the armed flight plane must leave in the trace (ISSUE 19):
+# a full turn of the ring and a sustained-drift anomaly episode.
+# check_flight exercises flight_drop for real; dispatch_anomaly fires
+# under a fake clock in tests/test_flight.py — listing both pins them
+# as genuinely emitted kinds in the lint
+FLIGHT_SPAN_KINDS = {"dispatch_anomaly", "flight_drop"}
 # span kinds the async path must leave in the trace (PR 5)
 ASYNC_SPAN_KINDS = {"enqueue", "ticket_wait", "unit_round"}
 # ...and the sparse-engine step path (PR 6)
@@ -587,6 +599,13 @@ def main():
                              f"admission families: {present}")
         if "tenants" in usage:
             raise ValueError("unarmed /usage leaked a tenants block")
+        # default-off purity (ISSUE 19): no --flight-recorder /
+        # --anomaly-detect, so the flight-plane families must be absent
+        # and the debug endpoints must 404 naming their arming flag
+        present = [m for m in FLIGHT_METRICS if m in types]
+        if present:
+            raise ValueError(f"unarmed scrape leaked armed-only flight "
+                             f"families: {present}")
         for path in ("/slo", "/debug/timeseries"):
             try:
                 call("GET", path)
@@ -598,6 +617,17 @@ def main():
                     raise ValueError(
                         f"unarmed GET {path} -> {e.code} {err}, expected "
                         f"a 404 naming --telemetry-interval-s")
+        for path, flag in (("/debug/flights", "--flight-recorder"),
+                           ("/debug/anomalies", "--anomaly-detect")):
+            try:
+                call("GET", path)
+                raise ValueError(f"unarmed server answered GET {path}")
+            except urllib.error.HTTPError as e:
+                err = json.loads(e.read().decode())
+                if e.code != 404 or flag not in err.get("error", ""):
+                    raise ValueError(
+                        f"unarmed GET {path} -> {e.code} {err}, expected "
+                        f"a 404 naming {flag}")
         _, body = call("GET", "/healthz")
         if "slo" in json.loads(body):
             raise ValueError("unarmed /healthz leaked an slo block")
@@ -1024,6 +1054,184 @@ def check_admission():
     return 0
 
 
+def check_flight():
+    """Armed-flight stage (ISSUE 19): a third server with the telemetry
+    sampler AND the flight plane armed — ring capacity deliberately tiny
+    so a short solo-step burst wraps it for real.  Every dispatch must
+    leave one flight record whose engine facts are self-consistent,
+    ``GET /debug/flights`` must honor its filters server-side, the wrap
+    must emit exactly one ``flight_drop`` trace event with the dropped
+    counter moved, ``GET /debug/anomalies`` must answer the armed payload
+    schema with the stepped signature under baseline tracking, and the
+    scrape must carry every flight-plane family.  (The drift detector
+    firing on injected latency — and the bounded profiler capture — run
+    under a fake clock in tests/test_flight.py; the unarmed half is
+    pinned in ``main()``.)"""
+    from mpi_tpu.obs import Obs
+    from mpi_tpu.serve.cache import EngineCache
+    from mpi_tpu.serve.httpd import make_server
+    from mpi_tpu.serve.session import SessionManager
+
+    obs = Obs(trace_capacity=4096)
+    manager = SessionManager(EngineCache(max_size=4), obs=obs,
+                             batching=False)
+    obs.arm_telemetry(interval_s=0.1, manager=manager)
+    workdir = tempfile.mkdtemp(prefix="mpi_tpu_flight_smoke_")
+    obs.arm_flight(capacity=8, manager=manager, anomaly=True,
+                   profile_dir=workdir)
+    server = make_server(port=0, manager=manager)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://{host}:{port}"
+
+    def call(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    try:
+        st, body = call("POST", "/sessions",
+                        {"rows": 16, "cols": 16, "backend": "tpu"})
+        assert st == 200, f"flight create -> {st}"
+        sid = json.loads(body)["id"]
+        for _ in range(12):             # capacity 8: 12 dispatches wrap
+            st, _body = call("POST", f"/sessions/{sid}/step", {"steps": 1})
+            assert st == 200, f"flight step -> {st}"
+
+        st, body = call("GET", "/debug/flights")
+        assert st == 200, f"armed /debug/flights -> {st}"
+        doc = json.loads(body)
+        missing = {"stats", "count", "flights"} - doc.keys()
+        if missing:
+            raise ValueError(f"/debug/flights payload missing "
+                             f"{sorted(missing)}")
+        stats = doc["stats"]
+        if stats != {"capacity": 8, "recorded": 12, "dropped": 4}:
+            raise ValueError(f"flight ring stats drifted: {stats}")
+        recs = doc["flights"]
+        if doc["count"] != 8 or len(recs) != 8:
+            raise ValueError(f"wrapped ring served {doc['count']} records, "
+                             f"expected the 8 survivors")
+        seqs = [r["seq"] for r in recs]
+        if seqs != sorted(seqs) or seqs[-1] != 11:
+            raise ValueError(f"ring survivors out of order or stale: "
+                             f"{seqs}")
+        sig = recs[0]["signature"]
+        for r in recs:
+            core = {"mode", "steps", "setup_s", "device_s", "block_s",
+                    "seq", "t_unix", "session", "signature", "engine",
+                    "donated", "tuned", "bitpacked", "k", "segments"}
+            missing = core - r.keys()
+            if missing:
+                raise ValueError(f"flight record missing {sorted(missing)}: "
+                                 f"{r}")
+            if r["mode"] != "solo" or r["session"] != sid \
+                    or r["steps"] != 1 or r["signature"] != sig \
+                    or r["engine"] not in ("dense", "fused", "sparse",
+                                           "seam"):
+                raise ValueError(f"flight record facts drifted: {r}")
+        # server-side filters over the same ring (the signature label
+        # has spaces — encoded like any real client would)
+        for query, want in ((f"session={sid}", 8), ("session=nope", 0),
+                            ("signature=" + urllib.parse.quote(sig), 8),
+                            ("slower_than=1e6", 0), ("limit=3", 3)):
+            st, body = call("GET", f"/debug/flights?{query}")
+            got = json.loads(body)["count"]
+            if st != 200 or got != want:
+                raise ValueError(f"?{query} -> {st} count={got}, "
+                                 f"expected {want}")
+        st, _body = call("GET", "/debug/flights?slower_than=abc")
+        if st != 400:
+            raise ValueError(f"malformed slower_than -> {st}, expected 400")
+        # one full turn of the ring = exactly one drop marker
+        drops = [r for r in obs.tracer.snapshot()
+                 if r["name"] == "flight_drop"]
+        if len(drops) != 1 or drops[0].get("dropped") != 8:
+            raise ValueError(f"expected one flight_drop event for the "
+                             f"wrap, got {drops}")
+
+        # /debug/anomalies: armed schema, the stepped signature under
+        # baseline tracking, and the evaluator actually ticking
+        deadline = time.monotonic() + 30
+        while True:
+            st, body = call("GET", "/debug/anomalies")
+            assert st == 200, f"armed /debug/anomalies -> {st}"
+            doc = json.loads(body)
+            if doc.get("evals", 0) >= 2:
+                break
+            if time.monotonic() >= deadline:
+                raise ValueError(f"anomaly evaluator never ticked: "
+                                 f"{doc.get('evals')}")
+            time.sleep(0.1)
+        missing = {"ratio", "damp_evals", "min_recent", "min_baseline",
+                   "windows_s", "baseline_s", "capture", "evals",
+                   "anomalies_total", "signatures", "episodes"} - doc.keys()
+        if missing:
+            raise ValueError(f"/debug/anomalies payload missing "
+                             f"{sorted(missing)}")
+        if set(doc["windows_s"]) != {"1m", "5m"}:
+            raise ValueError(f"recent drift windows drifted: "
+                             f"{doc['windows_s']}")
+        cap = doc["capture"]
+        if cap.get("profile_dir") != workdir or cap.get("captures") != 0:
+            raise ValueError(f"capture block drifted: {cap}")
+        rows = {s["sig"]: s for s in doc["signatures"]}
+        if sig not in rows or rows[sig]["baseline_count"] < 12 \
+                or rows[sig]["state"] != "ok":
+            raise ValueError(f"stepped signature not under baseline "
+                             f"tracking: {rows}")
+        if doc["episodes"]:
+            raise ValueError(f"steady-state smoke produced anomaly "
+                             f"episodes: {doc['episodes']}")
+
+        # the sampler grew the flight-plane series
+        st, body = call("GET", "/debug/timeseries")
+        listing = json.loads(body)
+        for series in ("device_memory_bytes", "engine_cache_entries"):
+            if series not in listing["series"]:
+                raise ValueError(f"telemetry listing lacks {series}: "
+                                 f"{listing['series']}")
+
+        st, text = call("GET", "/metrics")
+        types, samples = parse_prometheus(text)
+        missing = [m for m in FLIGHT_METRICS if m not in types]
+        if missing:
+            raise ValueError(f"armed scrape missing flight families: "
+                             f"{missing}")
+        vals = {n: v for n, labels, v in samples if not labels}
+        if vals.get("mpi_tpu_flight_records_total") != 12 \
+                or vals.get("mpi_tpu_flight_dropped_total") != 4:
+            raise ValueError(
+                f"flight counters drifted: "
+                f"records={vals.get('mpi_tpu_flight_records_total')} "
+                f"dropped={vals.get('mpi_tpu_flight_dropped_total')}")
+        mem_rows = [(labels.get("device"), labels.get("kind"))
+                    for n, labels, _ in samples
+                    if n == "mpi_tpu_device_memory_bytes"]
+        if not mem_rows or any(d is None or k is None for d, k in mem_rows):
+            raise ValueError(f"device memory gauge rows drifted: "
+                             f"{mem_rows}")
+        cache_rows = {labels.get("cache"): v for n, labels, v in samples
+                      if n == "mpi_tpu_engine_cache_entries"}
+        if cache_rows.get("engine", 0) < 1:
+            raise ValueError(f"engine cache occupancy rows drifted: "
+                             f"{cache_rows}")
+    finally:
+        server.shutdown()
+        server.server_close()
+        obs.close()
+    print(f"flight smoke OK: 12 dispatches, ring wrapped to 8 with one "
+          f"flight_drop, {len(mem_rows)} device memory rows, signature "
+          f"{sig} under anomaly baseline")
+    return 0
+
+
 def run_lint() -> None:
     """The static half of the drift gate: the same registry extraction
     that feeds REQUIRED_METRICS, cross-checked against the README and
@@ -1056,6 +1264,7 @@ if __name__ == "__main__":
             main()
             check_slo_telemetry()
             check_admission()
+            check_flight()
         sys.exit(0)
     except Exception as e:  # noqa: BLE001 — nonzero exit IS the contract
         print(f"obs smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)

@@ -34,10 +34,34 @@ from mpi_tpu.obs.tracectx import stitch_spans  # noqa: E402
 NAME_W = 36
 NODE_W = 18
 
+# observability-plane span kinds with a story beyond name+duration: the
+# annotation line decodes their fields so they do not read as unknown
+# rows in the waterfall (ISSUE 19)
+_KIND_NOTES = {
+    "dispatch_anomaly": lambda n: (
+        f"{n.get('direction', '?')} drift on sig={n.get('sig', '?')} "
+        f"ratios={n.get('ratios', {})} "
+        f"baseline_p50={n.get('baseline_p50')} "
+        f"exemplars={n.get('exemplars', [])}"
+        + (f" capture={n['capture']}" if n.get("capture") else "")),
+    "flight_drop": lambda n: (
+        f"flight ring wrapped: {n.get('dropped', '?')} records "
+        f"overwritten ({n.get('total', '?')} total)"),
+}
+
 
 def fetch(url: str, trace_id: str) -> dict:
     req = urllib.request.Request(
         f"{url.rstrip('/')}/debug/trace/{trace_id}")
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def fetch_flights(url: str, trace_id: str) -> dict:
+    """Server-side join: ``GET /debug/flights?trace=<id>`` matches a
+    record's own trace id or any batch-rider link."""
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/debug/flights?trace={trace_id}")
     with urllib.request.urlopen(req) as resp:
         return json.loads(resp.read())
 
@@ -102,11 +126,42 @@ def render(doc: dict, width: int = 100) -> str:
         bar = " " * a + "=" * b + " " * (bar_w - a - b)
         out.append(f"{name:<{NAME_W}} {str(node.get('node', '')):<{NODE_W}} "
                    f"{_fmt_dur(dur):>8} |{bar}|")
+        note = _KIND_NOTES.get(node.get("name"))
+        if note is not None:
+            out.append("  " * (depth + 1) + "^ " + note(node))
         for child in node.get("children") or ():
             emit(child, depth + 1)
 
     for root in doc.get("tree") or ():
         emit(root, 0)
+    return "\n".join(out)
+
+
+def render_flights(payload: dict) -> str:
+    """Compact table of the flight records joined to the trace."""
+    recs = payload.get("flights") or []
+    out = [f"flights: {len(recs)} record(s) "
+           f"(ring {payload.get('stats', {}).get('recorded', '?')} "
+           f"recorded)"]
+    if not recs:
+        out.append("  (no flight records reference this trace)")
+        return "\n".join(out)
+    out.append(f"  {'mode':<10} {'engine':<7} {'sig':<24} {'steps':>6} "
+               f"{'B':>3} {'device':>9} {'block':>9} session(s)")
+    for r in recs:
+        sids = r.get("session") or ",".join(r.get("sessions") or ())
+        sig = str(r.get("signature", "-"))[:24]
+        out.append(
+            f"  {r.get('mode', '?'):<10} {r.get('engine', '?'):<7} "
+            f"{sig:<24} {r.get('steps', 0):>6} "
+            f"{r.get('batch') or 1:>3} "
+            f"{_fmt_dur(r.get('device_s', 0.0)):>9} "
+            f"{_fmt_dur(r.get('block_s', 0.0)):>9} {sids}")
+        sp = r.get("sparse")
+        if sp:
+            out.append(f"    sparse: rung={sp.get('rung')} "
+                       f"active_tiles={sp.get('active_tiles')} "
+                       f"active_fraction={sp.get('active_fraction')}")
     return "\n".join(out)
 
 
@@ -123,6 +178,10 @@ def main(argv=None) -> int:
                          "instead of fetching")
     ap.add_argument("--width", type=int, default=100,
                     help="total output width (default 100)")
+    ap.add_argument("--flights", action="store_true",
+                    help="also join the trace id against GET "
+                         "/debug/flights on --url and append the "
+                         "matching dispatch flight records")
     args = ap.parse_args(argv)
     try:
         doc = (from_jsonl(args.from_jsonl, args.trace_id)
@@ -135,6 +194,16 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     print(render(doc, width=args.width))
+    if args.flights:
+        try:
+            print(render_flights(fetch_flights(args.url, args.trace_id)))
+        except urllib.error.HTTPError as e:
+            print(f"error: {args.url} answered {e.code}: "
+                  f"{e.read().decode(errors='replace')}", file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
     return 0
 
 

@@ -1783,6 +1783,190 @@ def serve_bench_wire() -> None:
     print(json.dumps(out))
 
 
+def serve_bench_viewport() -> None:
+    """`python bench.py --serve-viewport`: the viewport-serving gates
+    (ISSUE 20), both over REAL HTTP:
+
+    * **O(viewport) reads** — a 16384^2 board through the threaded
+      front: binary bytes of a full-board snapshot vs a 1024^2
+      windowed `GET /sessions/<s>/board?x0=..&y0=..&h=..&w=..`.
+      Gate: >= 10x fewer bytes windowed (the packed v2 frame gives
+      ~256x, so the gate has headroom); the window must decode equal
+      to the full board's slice.
+    * **quiescent delta stream** — a 512^2 all-dead board on the aio
+      front, two windowed streams on the SAME session: keyframe per
+      push vs `delta=1` dirty-tile frames.  After the subscribe
+      keyframe, every delta push of a quiescent board is an empty
+      53-byte heartbeat.  Gate: steady-state delta bytes >= 20x
+      smaller than the keyframe stream over the same pushes.
+
+    The final JSON line carries `plan: "viewport"` with the byte
+    ratio as `value` — a deterministic envelope row for
+    tools/bench_gate.py (byte ratios do not depend on the runner).
+    """
+    out = {"bench": "serve_viewport", "ok": False,
+           "metric": "viewport_bytes_ratio", "unit": "x",
+           "platform": "cpu", "size": 16384, "gens": 0,
+           "plan": "viewport"}
+    try:
+        import http.client
+        import socket as socketlib
+        import threading
+
+        import numpy as np
+
+        from mpi_tpu.serve import wire
+        from mpi_tpu.serve.aio import make_aio_server
+        from mpi_tpu.serve.cache import EngineCache
+        from mpi_tpu.serve.httpd import make_server
+        from mpi_tpu.serve.session import SessionManager
+
+        def start(srv):
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            return t
+
+        def stop(srv, t):
+            srv.shutdown()
+            srv.server_close()
+            t.join(timeout=10)
+
+        def call(srv, method, path, body=None, headers=None,
+                 raw_body=None):
+            host, port = srv.server_address[:2]
+            c = http.client.HTTPConnection(host, port, timeout=300)
+            t0 = time.perf_counter()
+            data = raw_body if raw_body is not None else (
+                json.dumps(body).encode() if body is not None else None)
+            c.request(method, path, body=data, headers=headers or {})
+            resp = c.getresponse()
+            raw = resp.read()
+            dt = time.perf_counter() - t0
+            assert resp.status == 200, (resp.status, raw[:200])
+            c.close()
+            return raw, len(raw), dt
+
+        # -- A: full-board vs windowed binary read at 16384^2 -----------
+        N, W = 16384, 1024
+        mgr = SessionManager(EngineCache(max_size=2))
+        srv = make_server(port=0, manager=mgr)
+        thread = start(srv)
+        try:
+            raw, _, _ = call(srv, "POST", "/sessions",
+                             {"rows": N, "cols": N, "backend": "serial",
+                              "seed": 7})
+            sid = json.loads(raw)["id"]
+            accept = {"Accept": wire.GRID_MEDIA_TYPE}
+            full_raw, full_bytes, full_s = call(
+                srv, "GET", f"/sessions/{sid}/snapshot", headers=accept)
+            x0 = y0 = (N - W) // 2
+            win_raw, win_bytes, win_s = call(
+                srv, "GET",
+                f"/sessions/{sid}/board?x0={x0}&y0={y0}&h={W}&w={W}",
+                headers=accept)
+            full_grid, _ = wire.decode_frame(full_raw)
+            win_grid, win_meta = wire.decode_frame(win_raw)
+            same = np.array_equal(
+                win_grid, full_grid[x0:x0 + W, y0:y0 + W])
+            call(srv, "DELETE", f"/sessions/{sid}")
+        finally:
+            stop(srv, thread)
+        ratio = full_bytes / win_bytes
+        viewport = {
+            "board": f"{N}x{N}", "window": f"{W}x{W}",
+            "full_bytes": full_bytes, "window_bytes": win_bytes,
+            "bytes_ratio": round(ratio, 1),
+            "full_s": round(full_s, 4), "window_s": round(win_s, 4),
+            "fetch_speedup": round(full_s / win_s, 2),
+            "decoded_equal": bool(same),
+        }
+        assert same, "windowed read != full-board slice"
+        assert win_meta["window"] == (x0, y0, W, W), win_meta
+        assert ratio >= 10.0, \
+            f"viewport bytes ratio {ratio:.1f} under the 10x gate"
+
+        # -- B: quiescent delta stream vs keyframe stream (aio front) ---
+        M, pushes = 512, 6
+        mgr = SessionManager(EngineCache(max_size=2))
+        srv = make_aio_server(port=0, manager=mgr)
+        thread = start(srv)
+        socks = []
+        try:
+            raw, _, _ = call(srv, "POST", "/sessions",
+                             {"rows": M, "cols": M, "backend": "tpu",
+                              "seed": 1})
+            sid = json.loads(raw)["id"]
+            # an all-dead board stays all-dead: every later delta frame
+            # is the empty heartbeat
+            zero = wire.encode_frame(np.zeros((M, M), dtype=np.uint8))
+            call(srv, "PUT", f"/sessions/{sid}/board", raw_body=zero,
+                 headers={"Content-Type": wire.GRID_MEDIA_TYPE})
+            host, port = srv.server_address[:2]
+
+            def open_stream(query):
+                s = socketlib.create_connection((host, port), timeout=60)
+                s.sendall(f"GET /stream/{sid}?{query} HTTP/1.1\r\n"
+                          f"Host: x\r\n\r\n".encode())
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    buf += s.recv(65536)
+                socks.append(s)
+                return s, bytearray(buf.split(b"\r\n\r\n", 1)[1])
+
+            def read_chunk(s, buf):
+                # one chunk == one frame on the stream wire
+                while b"\r\n" not in buf:
+                    buf += s.recv(65536)
+                head, rest = bytes(buf).split(b"\r\n", 1)
+                size = int(head, 16)
+                buf[:] = rest
+                while len(buf) < size + 2:
+                    buf += s.recv(65536)
+                frame = bytes(buf[:size])
+                buf[:] = buf[size + 2:]
+                return frame
+
+            base = f"every=1&x0=0&y0=0&h={M}&w={M}"
+            sk, kbuf = open_stream(base)            # keyframe per push
+            sd, dbuf = open_stream(base + "&delta=1")
+            read_chunk(sk, kbuf)                    # subscribe keyframes
+            first_delta = read_chunk(sd, dbuf)
+            _, meta0 = wire.decode_frame(first_delta)
+            assert not meta0["is_delta"], "first delta-stream frame " \
+                "must be a keyframe"
+            key_bytes = delta_bytes = 0
+            for _ in range(pushes):
+                call(srv, "POST", f"/sessions/{sid}/step", {"steps": 1})
+                key_bytes += len(read_chunk(sk, kbuf))
+                frame = read_chunk(sd, dbuf)
+                _, dm = wire.decode_frame(frame)
+                assert dm["is_delta"] and not dm["tiles"], \
+                    f"quiescent push was not an empty delta: {dm}"
+                delta_bytes += len(frame)
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            stop(srv, thread)
+        d_ratio = key_bytes / delta_bytes
+        delta_stream = {
+            "board": f"{M}x{M}", "pushes": pushes,
+            "keyframe_stream_bytes": key_bytes,
+            "delta_stream_bytes": delta_bytes,
+            "bytes_ratio": round(d_ratio, 1),
+        }
+        assert d_ratio >= 20.0, \
+            f"quiescent delta ratio {d_ratio:.1f} under the 20x gate"
+
+        out.update(ok=True, value=round(ratio, 1), viewport=viewport,
+                   delta_stream=delta_stream)
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
 def sparse_bench() -> None:
     """`python bench.py --sparse`: the activity-gating A/B (ISSUE 6).
 
@@ -2194,6 +2378,7 @@ MODES = {
     "--serve-flight": lambda argv: serve_bench_flight(),
     "--serve-admission": lambda argv: serve_bench_admission(),
     "--serve-wire": lambda argv: serve_bench_wire(),
+    "--serve-viewport": lambda argv: serve_bench_viewport(),
     "--sparse": lambda argv: sparse_bench(),
     "--tune": lambda argv: tune_bench(),
     "--fused": fused_bench,

@@ -872,6 +872,97 @@ class Engine:
             return out[:, : self.config.cols] if self.pad_bits else out
         return final
 
+    def fetch_window(self, grid, r0: int, c0: int, h: int, w: int,
+                     shard_timer=None):
+        """The host window ``[r0:r0+h, c0:c0+w]`` of the board, fetched
+        shard-by-shard: only device shards intersecting the window cross
+        the host tunnel (one ``np.asarray`` per intersecting shard),
+        never a full-board gather — the serving plane's O(viewport) read
+        path.  The window must not wrap (callers decompose a periodic
+        wrap into non-wrapping rectangles).  ``shard_timer(dt_s)`` is
+        called once per shard transfer when given.  None under
+        multi-host execution (same contract as :meth:`fetch`)."""
+        import time as _time
+
+        if jax.process_count() > 1:
+            return None
+        g = self.raw_grid(grid)
+        up = self._get_unpacker()
+        if up is not None:
+            g = up(g)                   # device-side unpack, still sharded
+        out = np.zeros((h, w), dtype=np.uint8)
+        cl = self.col_limit
+        for s in g.addressable_shards:
+            sr0 = s.index[0].start or 0
+            sc0 = s.index[1].start or 0
+            srows, scols = s.data.shape
+            if cl is not None:
+                scols = min(scols, cl - sc0)
+                if scols <= 0:
+                    continue            # shard lies entirely in the pad
+            ir0, ir1 = max(r0, sr0), min(r0 + h, sr0 + srows)
+            ic0, ic1 = max(c0, sc0), min(c0 + w, sc0 + scols)
+            if ir0 >= ir1 or ic0 >= ic1:
+                continue
+            t0 = _time.perf_counter()
+            tile = np.asarray(s.data)   # the per-shard transfer barrier
+            if shard_timer is not None:
+                shard_timer(_time.perf_counter() - t0)
+            out[ir0 - r0:ir1 - r0, ic0 - c0:ic1 - c0] = \
+                tile[ir0 - sr0:ir1 - sr0, ic0 - sc0:ic1 - sc0]
+        return out
+
+    def shard_snapshots(self, grid):
+        """``[(r0, c0, tile), ...]`` — every addressable shard's host
+        tile in board coordinates (bit columns, pad cropped), the
+        per-shard checkpoint payload: each tile is fetched and encoded
+        independently, so persistence never holds one full-board
+        array."""
+        return [(r0, c0, tile) for _pid, tile, r0, c0 in self.tiles(grid)]
+
+    def write_window(self, grid, r0: int, c0: int, patch):
+        """A new global grid with ``patch`` written at ``(r0, c0)``:
+        only shards intersecting the patch are fetched, edited, and
+        re-put; every other shard's device buffer is reused as-is — the
+        O(region) half of concurrent disjoint-region edits.  Returns
+        None when this engine cannot edit in place (sparse activity
+        state, whose dirty map a partial edit would stale; multi-host) —
+        the caller falls back to the full re-init path."""
+        if jax.process_count() > 1 or self.sparse_plan is not None:
+            return None
+        g = self.raw_grid(grid)
+        patch = np.asarray(patch, dtype=np.uint8)
+        h, w = patch.shape
+        if self.bitpacked:
+            from mpi_tpu.ops.bitlife import WORD, pack_np, unpack_np
+        arrays = []
+        for s in g.addressable_shards:
+            sr0 = s.index[0].start or 0
+            sc0 = s.index[1].start or 0
+            srows = s.data.shape[0]
+            if self.bitpacked:
+                sc0 *= WORD
+                scols = s.data.shape[1] * WORD
+            else:
+                scols = s.data.shape[1]
+            ir0, ir1 = max(r0, sr0), min(r0 + h, sr0 + srows)
+            ic0, ic1 = max(c0, sc0), min(c0 + w, sc0 + scols)
+            if ir0 >= ir1 or ic0 >= ic1:
+                arrays.append(s.data)   # untouched: reuse device buffer
+                continue
+            if self.bitpacked:
+                bits = unpack_np(np.asarray(s.data))
+            else:
+                bits = np.array(np.asarray(s.data), dtype=np.uint8,
+                                copy=True)
+            bits[ir0 - sr0:ir1 - sr0, ic0 - sc0:ic1 - sc0] = \
+                patch[ir0 - r0:ir1 - r0, ic0 - c0:ic1 - c0]
+            if self.bitpacked:
+                bits = pack_np(bits)
+            arrays.append(jax.device_put(bits, s.device))
+        return jax.make_array_from_single_device_arrays(
+            g.shape, g.sharding, arrays)
+
     def population(self, grid) -> int:
         """Live-cell count without fetching the whole grid (a rows-long
         vector crosses the host tunnel, not rows x cols cells).  Exact on

@@ -103,6 +103,18 @@ class Obs:
         self.engine_failures = m.counter(
             "mpi_tpu_engine_failures_observed_total",
             "Engine dispatch failures seen by the step path")
+        # viewport/sharded serving (ISSUE 20): windowed reads, dirty-tile
+        # delta streams, per-shard device transfers
+        self.viewport_bytes = m.counter(
+            "mpi_tpu_viewport_bytes_total",
+            "Windowed board-read payload bytes served, by transport front")
+        self.delta_frames = m.counter(
+            "mpi_tpu_delta_frames_total",
+            "Stream frames pushed by kind (kind=key|delta)")
+        self.shard_fetch = m.histogram(
+            "mpi_tpu_shard_fetch_seconds",
+            "Per-device-shard window transfer wall (viewport reads)",
+            IO_BUCKETS)
         # pre-bound series handles for the step hot path: observing
         # through these skips the per-call label resolution (~2 µs →
         # ~0.6 µs), and binding them here makes the /metrics schema
@@ -136,6 +148,11 @@ class Obs:
             for front in ("threaded", "aio"):
                 self.wire_encode.series(format=fmt, transport=front)
                 self.wire_decode.series(format=fmt, transport=front)
+        # same schema-stability discipline for the viewport families:
+        # both delta kinds render (at 0) from the first scrape
+        self.delta_frames.inc(0.0, kind="key")
+        self.delta_frames.inc(0.0, kind="delta")
+        self.shard_fetch_series = self.shard_fetch.series()
 
     # -- trace delegates -------------------------------------------------
 

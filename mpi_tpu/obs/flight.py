@@ -31,7 +31,7 @@ import itertools
 import json
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mpi_tpu.obs.tracectx import TRACE_CONTEXT
 from mpi_tpu.obs.trace import REQUEST_ID
@@ -87,13 +87,18 @@ class FlightRecorder:
                block_s: float = 0.0, sparse: Optional[dict] = None,
                rid: Optional[int] = None,
                links: Optional[List[str]] = None,
-               request_ids: Optional[List] = None) -> Dict[str, Any]:
+               request_ids: Optional[List] = None,
+               window: Optional[Tuple[int, int, int, int]] = None,
+               shards_touched: Optional[int] = None) -> Dict[str, Any]:
         """Record one committed dispatch.  ``engine`` is the live engine
-        the dispatch ran on — signature, kind, donation, tuning, and the
-        k-segment composition are derived here so the call sites stay
-        one line.  ``sparse`` is the ``sparse_stats`` dict the session
-        path already computed (never recomputed — a donated grid may be
-        gone by now)."""
+        the dispatch ran on — signature, kind, donation, tuning, mesh
+        shape, and the k-segment composition are derived here so the
+        call sites stay one line.  ``sparse`` is the ``sparse_stats``
+        dict the session path already computed (never recomputed — a
+        donated grid may be gone by now).  ``window`` (an ``x0, y0, h,
+        w`` viewport) and ``shards_touched`` attribute O(viewport)
+        reads: which board slice was served and how many device shards
+        it cost (ISSUE 20)."""
         steps = int(steps)
         rec: Dict[str, Any] = {
             "mode": mode,
@@ -120,8 +125,18 @@ class FlightRecorder:
             rec["k"] = k
             if steps:
                 rec["segments"] = {"full": steps // k, "rem": steps % k}
+            mi = getattr(engine, "mi", None)
+            mj = getattr(engine, "mj", None)
+            if mi and mj:
+                rec["mesh"] = f"{mi}x{mj}"
         else:
             rec["engine"] = "host"
+        if window is not None:
+            x0, y0, h, w = window
+            rec["window"] = {"x0": int(x0), "y0": int(y0),
+                             "h": int(h), "w": int(w)}
+        if shards_touched is not None:
+            rec["shards"] = int(shards_touched)
         if sparse is not None:
             rec["sparse"] = {
                 "active_tiles": sparse.get("active_tiles"),
@@ -152,7 +167,9 @@ class FlightRecorder:
         if i and i % self.capacity == 0 and self._obs is not None:
             self._obs.event("flight_drop", dropped=self.capacity, total=i)
         cb = self.on_record
-        if cb is not None:
+        # zero-step records (viewport reads) never feed the anomaly
+        # baseline — it models dispatch latency, not transfer wall
+        if cb is not None and steps:
             cb(sig, device_s, trace_id)
         return rec
 

@@ -506,6 +506,12 @@ class AioServer:
                 frame, gen = conn.pending_frame
                 conn.pending_frame = None
                 self._append_frame(conn, frame, gen)
+            if (not conn.wbuf and conn.stream is not None
+                    and conn.stream["key_pending"]):
+                # delta-stream resync: the drop discarded a frame, so
+                # fetch a fresh keyframe now that the socket drained
+                conn.stream["key_pending"] = False
+                self._request_frame(conn)
             if not conn.wbuf:
                 break
             try:
@@ -619,8 +625,18 @@ class AioServer:
     # -- streams -----------------------------------------------------------
 
     def _start_stream(self, conn: _Conn, plan: StreamPlan) -> None:
+        # delta-stream state beyond the classic trio: ``prev`` is the
+        # grid behind the last ENCODED frame (pool thread only — one job
+        # in flight per connection), ``force_key``/``key_pending`` drive
+        # the resync-after-drop protocol (a lost delta would silently
+        # diverge the client's reconstruction, so a drop discards the
+        # frame and schedules a keyframe once the socket drains)
         conn.stream = {"sid": plan.sid, "every": plan.every,
-                       "last": None, "dirty": False}
+                       "last": None, "dirty": False,
+                       "window": plan.window, "delta": plan.delta,
+                       "kf": plan.keyframe_every, "prev": None,
+                       "since_key": 0, "force_key": False,
+                       "key_pending": False}
         conn.busy = True                # the stream owns this connection
         conn.wbuf += self._head(200, wire.STREAM_MEDIA_TYPE, chunked=True)
         self._hub.setdefault(plan.sid, set()).add(conn)
@@ -655,9 +671,10 @@ class AioServer:
                 self._request_frame(conn)
 
     def _request_frame(self, conn: _Conn) -> None:
-        """Fetch+encode the session's current grid on the pool, then
-        deliver it to this stream (one job in flight per connection —
-        a burst of commits coalesces into one fetch of the latest)."""
+        """Fetch+encode the session's current grid (or viewport) on the
+        pool, then deliver it to this stream (one job in flight per
+        connection — a burst of commits coalesces into one fetch of the
+        latest)."""
         if conn.inflight or conn.closed or conn.stream is None:
             return
         st = conn.stream
@@ -668,17 +685,40 @@ class AioServer:
 
         def job():
             try:
-                grid, gen, config = self.manager.snapshot_array(sid)
+                if st["window"] is not None:
+                    wx0, wy0, wh, ww = st["window"]
+                    grid, gen, config = self.manager.snapshot_window(
+                        sid, wx0, wy0, wh, ww)
+                else:
+                    grid, gen, config = self.manager.snapshot_array(sid)
+                if st["delta"]:
+                    # the every-gate runs BEFORE encoding here: a delta
+                    # encoded but never delivered would still advance
+                    # the base grid and diverge the client (st["last"]
+                    # is a benign racy read — worst case one extra
+                    # frame, never a missed diff)
+                    last = st["last"]
+                    if last is not None and gen < last + st["every"]:
+                        self._enqueue(
+                            lambda: self._deliver_frame(conn, None, gen))
+                        return
                 t0 = time.perf_counter()
                 if core.obs is not None:
                     with core.obs.span("stream_push", sid=sid,
                                        generation=gen):
-                        frame = core.encode_grid_frame(grid, gen, config)
+                        frame, fk = self._encode_stream_frame(
+                            st, grid, gen, config)
                     core.obs.wire_encode.observe(
                         time.perf_counter() - t0, format="binary",
                         transport="aio")
+                    if fk in ("key", "delta"):
+                        core.obs.delta_frames.inc(kind=fk)
+                    if st["window"] is not None:
+                        core.obs.viewport_bytes.inc(len(frame),
+                                                    transport="aio")
                 else:
-                    frame = core.encode_grid_frame(grid, gen, config)
+                    frame, fk = self._encode_stream_frame(
+                        st, grid, gen, config)
                 self._enqueue(
                     lambda: self._deliver_frame(conn, frame, gen))
             except Exception:  # noqa: BLE001 — session closed/deadline:
@@ -687,17 +727,68 @@ class AioServer:
 
         self._pool.submit(job)
 
-    def _deliver_frame(self, conn: _Conn, frame: bytes, gen: int) -> None:
+    def _encode_stream_frame(self, st: dict, grid, gen: int, config):
+        """``(frame, kind)`` — the stream's next frame: a v1 full frame
+        (plain streams), a v2 windowed frame (viewport streams), or a
+        v2 keyframe/dirty-tile delta (delta streams; the delta base is
+        the previously ENCODED grid, touched only by this connection's
+        single in-flight pool job)."""
+        window = st["window"]
+        if not st["delta"]:
+            if window is None:
+                return (self.core.encode_grid_frame(grid, gen, config),
+                        "full")
+            return wire.encode_window_frame(
+                grid, x0=window[0], y0=window[1],
+                board_shape=(config.rows, config.cols), generation=gen,
+                rule=config.rule, boundary=config.boundary), "window"
+        x0, y0 = (window[0], window[1]) if window is not None else (0, 0)
+        prev = st["prev"]
+        need_key = (prev is None or st["force_key"]
+                    or prev.shape != grid.shape
+                    or st["since_key"] >= st["kf"])
+        st["prev"] = grid
+        if need_key:
+            st["force_key"] = False
+            st["since_key"] = 1
+            return wire.encode_window_frame(
+                grid, x0=x0, y0=y0,
+                board_shape=(config.rows, config.cols), generation=gen,
+                rule=config.rule, boundary=config.boundary), "key"
+        st["since_key"] += 1
+        tiles = wire.diff_tiles(prev, grid)
+        return wire.encode_delta_frame(
+            tiles, window=(x0, y0, grid.shape[0], grid.shape[1]),
+            board_shape=(config.rows, config.cols), generation=gen,
+            rule=config.rule, boundary=config.boundary), "delta"
+
+    def _deliver_frame(self, conn: _Conn, frame: Optional[bytes],
+                       gen: int) -> None:
         conn.inflight = False
         if conn.closed or conn.stream is None:
             return
         st = conn.stream
-        due = st["last"] is None or gen >= st["last"] + st["every"]
+        if frame is None:
+            # a delta stream's every-gate skipped this generation
+            if st["dirty"]:
+                self._request_frame(conn)
+            return
+        due = (st["delta"] or st["last"] is None
+               or gen >= st["last"] + st["every"])
         if due:
             if len(conn.wbuf) > self.stream_buffer:
-                # slow consumer: drop to latest, never queue unboundedly
-                conn.pending_frame = (frame, gen)
-                self.frames_dropped += 1
+                if st["delta"]:
+                    # a dropped delta would silently diverge the
+                    # client's reconstruction: discard it and resync
+                    # with a keyframe once the socket drains
+                    st["force_key"] = True
+                    st["key_pending"] = True
+                    self.frames_dropped += 1
+                else:
+                    # slow consumer: drop to latest, never queue
+                    # unboundedly
+                    conn.pending_frame = (frame, gen)
+                    self.frames_dropped += 1
             else:
                 conn.pending_frame = None
                 self._append_frame(conn, frame, gen)

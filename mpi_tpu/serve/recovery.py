@@ -96,10 +96,16 @@ _REC_HEADER = struct.Struct("<4sBBHII")
 # journal entry: magic, version, kind, reserved, generation, payload_len, crc
 _JRN_MAGIC = b"GOLJ"
 _JRN_HEADER = struct.Struct("<4sBBHQII")
-_J_MARK, _J_ROWS, _J_DELTA = 0, 1, 2
-_J_KINDS = {_J_MARK: "mark", _J_ROWS: "rows", _J_DELTA: "delta"}
+_J_MARK, _J_ROWS, _J_DELTA, _J_SHARD = 0, 1, 2, 3
+_J_KINDS = {_J_MARK: "mark", _J_ROWS: "rows", _J_DELTA: "delta",
+            _J_SHARD: "shard"}
 _ROWS_HEAD = struct.Struct("<II")       # rows, cols
 _DELTA_HEAD = struct.Struct("<III")     # rows, cols, changed-row count
+# shard content entry: board rows/cols, shard origin r0/c0, shard
+# rows/cols, then the shard's flat-packed bits (the same packing as a
+# record snapshot's "packed" field, so shard journal entries and shard
+# snapshot records can never pack differently)
+_SHARD_HEAD = struct.Struct("<IIIIII")
 _MAX_PAYLOAD = 1 << 30                  # sanity bound on declared lengths
 
 # persistence state machine backoff: 0.5 s doubling, capped
@@ -140,9 +146,76 @@ def encode_grid(grid: np.ndarray) -> dict:
     }
 
 
+def encode_grid_shards(tiles, rows: int, cols: int) -> dict:
+    """A shard-dimension snapshot: each device shard's tile packed
+    independently, so checkpoint and restore stream shard-by-shard and
+    never hold one (rows, cols) ndarray.  ``tiles`` is
+    ``[(r0, c0, tile_ndarray), ...]`` in board coordinates."""
+    return {
+        "rows": int(rows),
+        "cols": int(cols),
+        "shards": [
+            {
+                "r0": int(r0),
+                "c0": int(c0),
+                "rows": int(t.shape[0]),
+                "cols": int(t.shape[1]),
+                "packed": base64.b64encode(wire.pack_grid(t)).decode("ascii"),
+            }
+            for r0, c0, t in tiles
+        ],
+    }
+
+
 def decode_grid(snap: dict) -> np.ndarray:
     rows, cols = int(snap["rows"]), int(snap["cols"])
+    if "shards" in snap:
+        grid = np.zeros((rows, cols), dtype=np.uint8)
+        for sh in snap["shards"]:
+            r0, c0 = int(sh["r0"]), int(sh["c0"])
+            tr, tc = int(sh["rows"]), int(sh["cols"])
+            grid[r0:r0 + tr, c0:c0 + tc] = wire.unpack_grid(
+                base64.b64decode(sh["packed"]), tr, tc)
+        return grid
     return wire.unpack_grid(base64.b64decode(snap["packed"]), rows, cols)
+
+
+def snapshot_loader(snap: dict):
+    """A region loader ``f(r0, r1, c0, c1) -> uint8`` over a snapshot
+    dict — the restore-side half of per-shard checkpointing: a sharded
+    engine's ``init_grid`` pulls each device shard's region through
+    this, decoding only the stored shards that intersect it, so restore
+    never materializes the full board on one host.  Legacy full-grid
+    snapshots decode once, lazily."""
+    if "shards" in snap:
+        shards = [
+            (int(sh["r0"]), int(sh["c0"]), int(sh["rows"]), int(sh["cols"]),
+             sh["packed"])
+            for sh in snap["shards"]
+        ]
+
+        def load(r0: int, r1: int, c0: int, c1: int) -> np.ndarray:
+            out = np.zeros((r1 - r0, c1 - c0), dtype=np.uint8)
+            for sr0, sc0, srows, scols, packed in shards:
+                ir0, ir1 = max(r0, sr0), min(r1, sr0 + srows)
+                ic0, ic1 = max(c0, sc0), min(c1, sc0 + scols)
+                if ir0 >= ir1 or ic0 >= ic1:
+                    continue
+                tile = wire.unpack_grid(base64.b64decode(packed),
+                                        srows, scols)
+                out[ir0 - r0:ir1 - r0, ic0 - c0:ic1 - c0] = \
+                    tile[ir0 - sr0:ir1 - sr0, ic0 - sc0:ic1 - sc0]
+            return out
+
+        return load
+    cache = {}
+
+    def load_full(r0: int, r1: int, c0: int, c1: int) -> np.ndarray:
+        if "grid" not in cache:
+            cache["grid"] = decode_grid(snap)
+        return cache["grid"][r0:r1, c0:c1]
+
+    return load_full
 
 
 # -- envelope / journal frame codecs ---------------------------------------
@@ -247,17 +320,24 @@ def _unpack_rows(packed: np.ndarray, cols: int) -> np.ndarray:
 
 class _ChainState:
     """The working content state of a journal replay: a per-row packed
-    matrix plus the generations it describes."""
+    matrix (full-board entries) and/or a per-shard tile map (shard
+    entries) plus the generations they describe."""
 
-    __slots__ = ("packed", "rows", "cols", "gen", "content_gen", "touched")
+    __slots__ = ("packed", "rows", "cols", "gen", "content_gen", "touched",
+                 "shards")
 
-    def __init__(self, packed, rows, cols, gen, content_gen):
+    def __init__(self, packed, rows, cols, gen, content_gen, shards=None):
         self.packed = packed            # (rows, ceil(cols/8)) u8 or None
         self.rows = rows
         self.cols = cols
         self.gen = gen
         self.content_gen = content_gen
         self.touched = False            # any content entry applied?
+        # {(r0, c0): (srows, scols, flat_packed_bytes)} — shard-mode
+        # content; coexists with ``packed`` only across a mode switch
+        # (old full record + new shard commits), where assembly overlays
+        # the tiles on the unpacked base
+        self.shards = shards
 
     def apply(self, kind: int, gen: int, payload: bytes) -> bool:
         """Fold one journal entry; False means the chain is broken at
@@ -278,6 +358,7 @@ class _ChainState:
                 payload, dtype=np.uint8, offset=_ROWS_HEAD.size,
             ).reshape(rows, (cols + 7) // 8).copy()
             self.rows, self.cols = rows, cols
+            self.shards = None          # a full-board entry supersedes tiles
             self.gen = self.content_gen = gen
             self.touched = True
             return True
@@ -304,7 +385,51 @@ class _ChainState:
             self.gen = self.content_gen = gen
             self.touched = True
             return True
+        if kind == _J_SHARD:
+            if len(payload) < _SHARD_HEAD.size:
+                return False
+            brows, bcols, r0, c0, srows, scols = _SHARD_HEAD.unpack_from(
+                payload)
+            nbytes = (srows * scols + 7) // 8
+            if (srows < 1 or scols < 1 or brows < 1 or bcols < 1
+                    or r0 + srows > brows or c0 + scols > bcols
+                    or len(payload) != _SHARD_HEAD.size + nbytes):
+                return False
+            if self.rows and (brows != self.rows or bcols != self.cols):
+                return False
+            if self.shards is None:
+                self.shards = {}
+            self.shards[(r0, c0)] = (srows, scols,
+                                     payload[_SHARD_HEAD.size:])
+            self.rows, self.cols = brows, bcols
+            self.gen = self.content_gen = gen
+            self.touched = True
+            return True
         return False                    # unknown kind: future version
+
+    def snapshot(self) -> dict:
+        """The replay result as a record snapshot dict (no generation
+        key — the caller stamps ``content_gen``).  Pure shard mode
+        emits a shard-form snapshot; a mode mix (full base overlaid
+        with shard tiles) assembles and re-encodes full."""
+        if self.shards and self.packed is None:
+            return {
+                "rows": int(self.rows),
+                "cols": int(self.cols),
+                "shards": [
+                    {"r0": int(r0), "c0": int(c0), "rows": int(sr),
+                     "cols": int(sc),
+                     "packed": base64.b64encode(pk).decode("ascii")}
+                    for (r0, c0), (sr, sc, pk) in sorted(self.shards.items())
+                ],
+            }
+        if self.shards:
+            grid = _unpack_rows(self.packed, self.cols)
+            for (r0, c0), (sr, sc, pk) in sorted(self.shards.items()):
+                grid[r0:r0 + sr, c0:c0 + sc] = wire.unpack_grid(
+                    bytes(pk), sr, sc)
+            return encode_grid(grid)
+        return encode_grid(_unpack_rows(self.packed, self.cols))
 
 
 class _JournalTrack:
@@ -313,14 +438,17 @@ class _JournalTrack:
     age for compaction triggers.  Guarded by the owning session's lock
     (the same discipline as ``save``)."""
 
-    __slots__ = ("prev", "gen", "size", "entries", "opened")
+    __slots__ = ("prev", "gen", "size", "entries", "opened", "prev_shards")
 
-    def __init__(self, prev, gen):
+    def __init__(self, prev, gen, prev_shards=None):
         self.prev = prev                # packed per-row content or None
         self.gen = gen
         self.size = 0                   # durable (fsynced) journal bytes
         self.entries = 0
         self.opened = time.monotonic()
+        # {(r0, c0): flat_packed_bytes} — the last journaled per-shard
+        # content, so a shard commit appends only the tiles that changed
+        self.prev_shards = prev_shards
 
 
 class StateStore:
@@ -574,11 +702,18 @@ class StateStore:
             if compaction:
                 self.compactions += 1
         if self.journal:
-            prev = None
-            if snapshot is not None:
+            prev, prev_shards = None, None
+            if snapshot is not None and "shards" in snapshot:
+                prev_shards = {
+                    (int(sh["r0"]), int(sh["c0"])):
+                        base64.b64decode(sh["packed"])
+                    for sh in snapshot["shards"]
+                }
+            elif snapshot is not None:
                 prev = _pack_rows(decode_grid(snapshot))
             with self._lock:
-                self._jrn[sid] = _JournalTrack(prev, int(generation))
+                self._jrn[sid] = _JournalTrack(prev, int(generation),
+                                               prev_shards)
 
     def _rotate(self, sid: str) -> None:
         """Shift the head record and its journal one step down the
@@ -603,14 +738,17 @@ class StateStore:
                 pass
 
     def commit_step(self, sid: str, spec: dict, generation: int,
-                    snapshot: Optional[dict], grid=None) -> dict:
-        """The step-commit persistence verb: append one journal entry
+                    snapshot: Optional[dict], grid=None,
+                    shards=None) -> dict:
+        """The step-commit persistence verb: append journal entries
         when journaling (a content ``rows``/``delta`` entry when
-        ``grid`` rode along, a ``mark`` otherwise), or rewrite the full
-        record (journaling off, no chain base yet, or compaction due).
-        Returns ``{"form": "record"|"journal", "kind", "bytes",
-        "compacted"}`` for the caller's observability.  Raises
-        ``OSError`` like :meth:`save`."""
+        ``grid`` rode along, one ``shard`` entry per *changed* device
+        shard when ``shards=(brows, bcols, tiles)`` rode along, a
+        ``mark`` otherwise), or rewrite the full record (journaling
+        off, no chain base yet, or compaction due).  Returns
+        ``{"form": "record"|"journal", "kind", "bytes", "compacted"}``
+        for the caller's observability.  Raises ``OSError`` like
+        :meth:`save`."""
         if not self.journal:
             self.save(sid, spec, generation, snapshot)
             return {"form": "record", "kind": None, "bytes": 0,
@@ -627,8 +765,13 @@ class StateStore:
             self.save(sid, spec, generation, snapshot, compaction=True)
             return {"form": "record", "kind": None, "bytes": 0,
                     "compacted": True}
-        kind, payload = self._encode_step(st, grid)
-        blob = _jrn_encode(kind, int(generation), payload)
+        new_shards = None
+        if shards is not None:
+            kind, blob, new_shards = self._encode_step_shards(
+                st, int(generation), shards)
+        else:
+            kind, payload = self._encode_step(st, grid)
+            blob = _jrn_encode(kind, int(generation), payload)
         self._gate(sid)
         jpath = self._jpath(sid)
         try:
@@ -653,11 +796,39 @@ class StateStore:
         st.gen = int(generation)
         if kind != _J_MARK and grid is not None:
             st.prev = _pack_rows(grid)
+        if new_shards is not None:
+            st.prev_shards = new_shards
         with self._lock:
             self.journal_appends += 1
             self.bytes_delta += len(blob)
         return {"form": "journal", "kind": _J_KINDS[kind],
                 "bytes": len(blob), "compacted": False}
+
+    @staticmethod
+    def _encode_step_shards(st: _JournalTrack, generation: int,
+                            shards) -> Tuple[int, bytes, Optional[dict]]:
+        """Encode a shard-dimension commit: one ``shard`` journal frame
+        per tile whose packed content changed since the last journaled
+        state (all of them when there is no shard baseline), CRC-framed
+        independently so a torn multi-shard append loses only its tail.
+        A quiescent commit degenerates to a ``mark``."""
+        brows, bcols, tiles = shards
+        prev = st.prev_shards
+        frames = []
+        new_prev = {} if prev is None else dict(prev)
+        for r0, c0, tile in tiles:
+            arr = np.asarray(tile, dtype=np.uint8)
+            packed = wire.pack_grid(arr)
+            key = (int(r0), int(c0))
+            if prev is not None and prev.get(key) == packed:
+                continue
+            new_prev[key] = packed
+            head = _SHARD_HEAD.pack(int(brows), int(bcols), key[0], key[1],
+                                    arr.shape[0], arr.shape[1])
+            frames.append(_jrn_encode(_J_SHARD, generation, head + packed))
+        if not frames:
+            return _J_MARK, _jrn_encode(_J_MARK, generation, b""), new_prev
+        return _J_SHARD, b"".join(frames), new_prev
 
     @staticmethod
     def _encode_step(st: _JournalTrack, grid) -> Tuple[int, bytes]:
@@ -772,10 +943,23 @@ class StateStore:
         snap = base.get("snapshot")
         if snap is not None:
             try:
-                chain = _ChainState(_pack_rows(decode_grid(snap)),
-                                    int(snap["rows"]), int(snap["cols"]),
-                                    int(base["generation"]),
-                                    int(snap["generation"]))
+                if "shards" in snap:
+                    shards = {
+                        (int(sh["r0"]), int(sh["c0"])):
+                            (int(sh["rows"]), int(sh["cols"]),
+                             base64.b64decode(sh["packed"]))
+                        for sh in snap["shards"]
+                    }
+                    chain = _ChainState(None,
+                                        int(snap["rows"]), int(snap["cols"]),
+                                        int(base["generation"]),
+                                        int(snap["generation"]),
+                                        shards=shards)
+                else:
+                    chain = _ChainState(_pack_rows(decode_grid(snap)),
+                                        int(snap["rows"]), int(snap["cols"]),
+                                        int(base["generation"]),
+                                        int(snap["generation"]))
             except (KeyError, TypeError, ValueError):
                 return None             # snapshot dict itself is malformed
         else:
@@ -803,8 +987,7 @@ class StateStore:
         out["v"] = RECORD_VERSION
         out["generation"] = chain.gen
         if chain.touched:
-            grid = _unpack_rows(chain.packed, chain.cols)
-            ns = encode_grid(grid)
+            ns = chain.snapshot()
             ns["generation"] = chain.content_gen
             out["snapshot"] = ns
         return out
@@ -909,6 +1092,59 @@ def _sid_ordinal(sid: str) -> int:
 # -- offline verification (tools/scrub.py) ---------------------------------
 
 
+def _snapshot_issue(rec: dict) -> Optional[str]:
+    """Validate a decoded record's snapshot payload beyond the CRC —
+    in particular the shard-dimension layout (each shard's base64
+    packed bytes must match its declared geometry), so a scrub of a
+    post-kill state dir verifies per-shard records all the way down."""
+    snap = rec.get("snapshot")
+    if snap is None:
+        return None
+    try:
+        rows, cols = int(snap["rows"]), int(snap["cols"])
+        if "shards" in snap:
+            for sh in snap["shards"]:
+                r0, c0 = int(sh["r0"]), int(sh["c0"])
+                tr, tc = int(sh["rows"]), int(sh["cols"])
+                if tr < 1 or tc < 1 or r0 + tr > rows or c0 + tc > cols:
+                    return (f"shard {tr}x{tc}@({r0},{c0}) escapes the "
+                            f"{rows}x{cols} board")
+                need = (tr * tc + 7) // 8
+                got = len(base64.b64decode(sh["packed"]))
+                if got != need:
+                    return (f"shard @({r0},{c0}) packed length {got} "
+                            f"disagrees with geometry {tr}x{tc} "
+                            f"(expected {need})")
+        else:
+            need = (rows * cols + 7) // 8
+            got = len(base64.b64decode(snap["packed"]))
+            if got != need:
+                return (f"snapshot packed length {got} disagrees with "
+                        f"geometry {rows}x{cols} (expected {need})")
+    except (KeyError, TypeError, ValueError) as e:
+        return f"malformed snapshot: {e}"
+    return None
+
+
+def _journal_entry_issue(kind: int, payload: bytes) -> Optional[str]:
+    """Shape-validate one CRC-verified journal entry the way replay
+    would — scrub's structural check over the shard-aware kinds."""
+    if kind == _J_SHARD:
+        if len(payload) < _SHARD_HEAD.size:
+            return "shard entry shorter than its head"
+        brows, bcols, r0, c0, srows, scols = _SHARD_HEAD.unpack_from(payload)
+        nbytes = (srows * scols + 7) // 8
+        if srows < 1 or scols < 1 or r0 + srows > brows or c0 + scols > bcols:
+            return (f"shard entry {srows}x{scols}@({r0},{c0}) escapes the "
+                    f"{brows}x{bcols} board")
+        if len(payload) != _SHARD_HEAD.size + nbytes:
+            return (f"shard entry payload {len(payload)} disagrees with "
+                    f"geometry {srows}x{scols}")
+    elif kind not in _J_KINDS:
+        return f"unknown journal entry kind {kind}"
+    return None
+
+
 def scan_state_dir(state_dir: str, repair: bool = False) -> dict:
     """Walk every record (head + ancestors) and journal under
     ``state_dir``, verify each CRC frame, and report.  ``repair=True``
@@ -921,6 +1157,7 @@ def scan_state_dir(state_dir: str, repair: bool = False) -> dict:
         "records_corrupt": 0,
         "journals_ok": 0,
         "journal_entries": 0,
+        "shard_entries": 0,
         "torn_tails": 0,
         "stale_tmp": 0,
         "quarantined": [],
@@ -970,7 +1207,10 @@ def scan_state_dir(state_dir: str, repair: bool = False) -> dict:
         if name.endswith(".json") or re.search(r"\.json\.\d+$", name):
             try:
                 with open(path, "rb") as f:
-                    _rec_decode(f.read())
+                    rec = _rec_decode(f.read())
+                issue = _snapshot_issue(rec)
+                if issue is not None:
+                    raise RecordCorrupt(issue)
                 report["records_ok"] += 1
             except OSError as e:
                 report["issues"].append(f"{name}: unreadable ({e})")
@@ -1002,6 +1242,12 @@ def scan_state_dir(state_dir: str, repair: bool = False) -> dict:
                 continue
             entries, good, torn = _jrn_scan(raw)
             report["journal_entries"] += len(entries)
+            for kind, _gen, payload in entries:
+                if kind == _J_SHARD:
+                    report["shard_entries"] += 1
+                issue = _journal_entry_issue(kind, payload)
+                if issue is not None:
+                    report["issues"].append(f"{name}: {issue}")
             if torn:
                 report["torn_tails"] += 1
                 report["issues"].append(

@@ -499,6 +499,13 @@ class SessionManager:
             return
         with session.lock:
             if session.engine is not None:
+                if self._sharded(session.engine):
+                    # per-shard drain checkpoint: each device shard is
+                    # fetched and packed independently — no full-board
+                    # host array even at handoff time
+                    tiles = session.engine.shard_snapshots(session.grid)
+                    self._persist(session, shards=tiles, raise_errors=True)
+                    return
                 grid_np = session.engine.fetch(session.grid)
             else:
                 grid_np = np.asarray(session.grid, dtype=np.uint8)
@@ -699,6 +706,10 @@ class SessionManager:
         def stepper(g, n):
             return evolve_np(g, n, rule, boundary)
 
+        if callable(initial):
+            # a shard-form restore hands a region loader; the host
+            # oracle needs the assembled board
+            initial = initial(0, config.rows, 0, config.cols)
         grid = (np.asarray(initial, dtype=np.uint8) if initial is not None
                 else init_tile_np(config.rows, config.cols, config.seed))
         session = Session("?", config, stepper=stepper, grid=grid)
@@ -762,21 +773,35 @@ class SessionManager:
 
     # -- checkpoint / restore ---------------------------------------------
 
+    @staticmethod
+    def _sharded(engine) -> bool:
+        """True when the engine spans more than one device shard — the
+        cue to checkpoint shard-by-shard instead of through one
+        full-board host array (sparse engines are always 1x1, so the
+        shard path never sees a SparseState)."""
+        return engine is not None and engine.mi * engine.mj > 1
+
     def _persist(self, session: Session, grid_np=None,  # lint: disable=lock-discipline -- caller holds session.lock (step path) or the session is pre-publication (create/restore)
-                 raise_errors: bool = False) -> None:
+                 raise_errors: bool = False, shards=None) -> None:
         """Write the session's full durable record (caller holds the
         session lock on the step path; create/restore call it
         pre-publication).  ``grid_np``: a freshly fetched host grid to
-        snapshot, or None to keep the previous snapshot.  Store failures
-        are counted, noted, and swallowed — durability must degrade, not
-        take the step down with it — unless ``raise_errors`` (the drain
-        path: handing off a session whose checkpoint did not land would
-        lose generations)."""
+        snapshot; ``shards``: ``[(r0, c0, tile), ...]`` device-shard
+        tiles to snapshot in shard form (never assembled); None for both
+        keeps the previous snapshot.  Store failures are counted, noted,
+        and swallowed — durability must degrade, not take the step down
+        with it — unless ``raise_errors`` (the drain path: handing off a
+        session whose checkpoint did not land would lose generations)."""
         if self.store is None or session.spec is None:
             return
         try:
             t0 = time.perf_counter()
-            if grid_np is not None:
+            if shards is not None:
+                snap = recovery.encode_grid_shards(
+                    shards, session.config.rows, session.config.cols)
+                snap["generation"] = session.generation
+                session.ckpt = snap
+            elif grid_np is not None:
                 snap = recovery.encode_grid(grid_np)
                 snap["generation"] = session.generation
                 session.ckpt = snap
@@ -787,7 +812,8 @@ class SessionManager:
                 self.obs.checkpoint_write.observe(dt)
                 self.obs.event("checkpoint_write", dt, t0, sid=session.id,
                                generation=session.generation,
-                               snapshot=grid_np is not None)
+                               snapshot=(grid_np is not None
+                                         or shards is not None))
         except recovery.StorageDegradedError:
             # fast-fail while degraded: already queued as pending and
             # counted by the store; no stderr spam per skipped write
@@ -811,11 +837,19 @@ class SessionManager:
         if self.store is None or session.spec is None:
             return
         grid_np = None
+        tiles = None
         last = session.ckpt["generation"] if session.ckpt else 0
         if session.generation - last >= self.store.checkpoint_every:
             try:
                 if session.engine is not None:
-                    grid_np = session.engine.fetch(session.grid)
+                    if self._sharded(session.engine):
+                        # shard-form fetch: one host tile per device
+                        # shard, packed independently downstream — the
+                        # journal then appends only the CHANGED shards
+                        tiles = session.engine.shard_snapshots(
+                            session.grid)
+                    else:
+                        grid_np = session.engine.fetch(session.grid)
                 else:
                     grid_np = np.asarray(session.grid, dtype=np.uint8)
             except Exception as e:  # noqa: BLE001 — snapshot is an optimization
@@ -823,15 +857,23 @@ class SessionManager:
                 print(f"note: checkpoint fetch failed for {session.id}: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
                 grid_np = None
+                tiles = None
         try:
             t0 = time.perf_counter()
-            if grid_np is not None:
+            if tiles is not None:
+                snap = recovery.encode_grid_shards(
+                    tiles, session.config.rows, session.config.cols)
+                snap["generation"] = session.generation
+                session.ckpt = snap
+            elif grid_np is not None:
                 snap = recovery.encode_grid(grid_np)
                 snap["generation"] = session.generation
                 session.ckpt = snap
-            info = self.store.commit_step(session.id, session.spec,
-                                          session.generation, session.ckpt,
-                                          grid=grid_np)
+            info = self.store.commit_step(
+                session.id, session.spec, session.generation, session.ckpt,
+                grid=grid_np,
+                shards=None if tiles is None else
+                (session.config.rows, session.config.cols, tiles))
             if self.obs is not None:
                 dt = time.perf_counter() - t0
                 if info["form"] == "journal":
@@ -870,15 +912,21 @@ class SessionManager:
         config, segments = _parse_spec(rec["spec"])
         target_gen = int(rec["generation"])
         snap = rec.get("snapshot")
-        initial = recovery.decode_grid(snap) if snap else None
         start_gen = int(snap["generation"]) if snap else 0
         if not 0 <= start_gen <= target_gen:
             raise ValueError(
                 f"snapshot generation {start_gen} outside 0..{target_gen}")
         t0 = time.perf_counter()
         if config.backend == "tpu":
+            # restore through a region loader: each device shard pulls
+            # only its own rectangle, decoding only the stored shards
+            # that intersect it — the full board never materializes on
+            # this host (legacy full-grid snapshots decode once, lazily,
+            # behind the same interface)
+            initial = recovery.snapshot_loader(snap) if snap else None
             session = self._create_tpu(config, segments, initial=initial)
         else:
+            initial = recovery.decode_grid(snap) if snap else None
             session = self._create_host(config)
             if initial is not None:
                 session.grid = initial
@@ -1372,6 +1420,96 @@ class SessionManager:
                 "rows": config.rows, "cols": config.cols,
                 "grid": format_grid_rows(grid)}
 
+    @staticmethod
+    def window_rects(x0: int, y0: int, h: int, w: int, rows: int,
+                      cols: int, boundary: str):
+        """Non-wrapping board rectangles covering a requested window,
+        each tagged with its offset inside the output array:
+        ``[(out_r, out_c, r0, c0, rh, rw), ...]``.  Periodic boards wrap
+        (up to four rectangles); any other boundary requires the window
+        to sit fully inside the board."""
+        if h < 1 or w < 1:
+            raise ConfigError(f"window extent must be >= 1, got {h}x{w}")
+        if not (0 <= x0 < rows and 0 <= y0 < cols):
+            raise ConfigError(
+                f"window origin ({x0},{y0}) is off the {rows}x{cols} board")
+        if h > rows or w > cols:
+            raise ConfigError(
+                f"window {h}x{w} exceeds the {rows}x{cols} board")
+        wraps = x0 + h > rows or y0 + w > cols
+        if wraps and boundary != "periodic":
+            raise ConfigError(
+                f"window [{x0}:{x0 + h}, {y0}:{y0 + w}] leaves the "
+                f"{rows}x{cols} board and boundary {boundary!r} does "
+                f"not wrap")
+        r_spans = [(0, x0, min(h, rows - x0))]
+        if x0 + h > rows:
+            r_spans.append((rows - x0, 0, x0 + h - rows))
+        c_spans = [(0, y0, min(w, cols - y0))]
+        if y0 + w > cols:
+            c_spans.append((cols - y0, 0, y0 + w - cols))
+        return [(out_r, out_c, r0, c0, rh, rw)
+                for out_r, r0, rh in r_spans
+                for out_c, c0, rw in c_spans]
+
+    def snapshot_window(self, sid: str, x0: int, y0: int, h: int, w: int,
+                        timeout_s: Optional[float] = None):
+        """``(window_np, generation, config)`` for one viewport — the
+        O(viewport) read path: only device shards intersecting the
+        window cross the host tunnel (per-shard ``device_get``), never a
+        full-board gather.  A window crossing the periodic wrap is
+        decomposed into up to four non-wrapping rectangles.  Same
+        lock/deadline discipline as :meth:`snapshot_array`."""
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(
+            lambda: self._snapshot_window(sid, x0, y0, h, w), deadline,
+            f"snapshot_window({sid})")
+
+    def _snapshot_window(self, sid: str, x0: int, y0: int, h: int, w: int):
+        session = self.get(sid)
+        x0, y0, h, w = int(x0), int(y0), int(h), int(w)
+        rects = self.window_rects(x0, y0, h, w, session.config.rows,
+                                   session.config.cols,
+                                   session.config.boundary)
+        obs = self.obs
+        timer = None
+        fetched = {"n": 0, "s": 0.0}
+        if obs is not None:
+            series = obs.shard_fetch_series
+
+            def timer(dt_s, _series=series, _f=fetched):
+                _f["n"] += 1
+                _f["s"] += dt_s
+                _series.observe(dt_s)
+        with session.lock:
+            if session.closed:
+                raise KeyError(sid)
+            # same torn-read discipline as snapshot: generation leaves
+            # the lock with the cells it labels
+            generation = session.generation
+            out = np.empty((h, w), dtype=np.uint8)
+            if session.engine is not None:
+                for out_r, out_c, r0, c0, rh, rw in rects:
+                    part = session.engine.fetch_window(
+                        session.grid, r0, c0, rh, rw, shard_timer=timer)
+                    if part is None:
+                        raise ConfigError(
+                            "viewport over HTTP needs single-host "
+                            "execution")
+                    out[out_r:out_r + rh, out_c:out_c + rw] = part
+            else:
+                grid = np.asarray(session.grid, dtype=np.uint8)
+                for out_r, out_c, r0, c0, rh, rw in rects:
+                    out[out_r:out_r + rh,
+                        out_c:out_c + rw] = grid[r0:r0 + rh, c0:c0 + rw]
+            fl = obs.flight if obs is not None else None
+            if fl is not None:
+                fl.record("viewport", engine=session.engine,
+                          session=sid, device_s=fetched["s"],
+                          window=(x0, y0, h, w),
+                          shards_touched=fetched["n"])
+        return out, generation, session.config
+
     def write_board(self, sid: str, grid, generation: Optional[int] = None,
                     timeout_s: Optional[float] = None) -> dict:
         """Overwrite a live board's grid (the board-write endpoint).
@@ -1417,6 +1555,93 @@ class SessionManager:
         if self.obs is not None:
             self.obs.event("board_write", sid=sid,
                            generation=out["generation"])
+        self._notify_step(session)
+        return out
+
+    def write_window(self, sid: str, x0: int, y0: int, patch,
+                     generation: Optional[int] = None,
+                     timeout_s: Optional[float] = None) -> dict:
+        """Write one region of a live board (the windowed board-write
+        endpoint): only device shards intersecting the patch are
+        fetched, edited, and re-put, so concurrent editors of disjoint
+        regions never pay O(board).  ``generation`` follows the same
+        rebase seam as :meth:`write_board`.  Like a full write, the
+        result is persisted immediately (shard form on sharded
+        engines): replay-from-seed is invalid once a board has been
+        edited."""
+        deadline = _Deadline(self._budget(timeout_s))
+        return _watchdog_call(
+            lambda: self._write_window(sid, x0, y0, patch, generation),
+            deadline, f"write_window({sid})")
+
+    def _write_window(self, sid: str, x0: int, y0: int, patch,
+                      generation: Optional[int]) -> dict:
+        self._storage_gate(mutating=True)
+        session = self.get(sid)
+        arr = np.ascontiguousarray(patch, dtype=np.uint8)
+        if arr.ndim != 2:
+            raise ConfigError(f"patch must be 2-D, got shape {arr.shape}")
+        if arr.max(initial=0) > 1:
+            raise ConfigError("grid cells must be 0 or 1")
+        x0, y0 = int(x0), int(y0)
+        rects = self.window_rects(x0, y0, arr.shape[0], arr.shape[1],
+                                   session.config.rows,
+                                   session.config.cols,
+                                   session.config.boundary)
+        with session.lock:
+            if session.closed:
+                raise KeyError(sid)
+            if session.engine is not None:
+                grid = session.grid
+                for out_r, out_c, r0, c0, rh, rw in rects:
+                    part = arr[out_r:out_r + rh, out_c:out_c + rw]
+                    grid = session.engine.write_window(grid, r0, c0, part)
+                    if grid is None:
+                        break
+                if grid is not None:
+                    session.grid = grid
+                else:
+                    # sparse engines cannot edit in place (a partial
+                    # edit would stale the dirty map): fall back to the
+                    # full fetch-edit-reinit path
+                    full = session.engine.fetch(session.grid)
+                    if full is None:
+                        raise ConfigError(
+                            "board write over HTTP needs single-host "
+                            "execution")
+                    for out_r, out_c, r0, c0, rh, rw in rects:
+                        full[r0:r0 + rh, c0:c0 + rw] = \
+                            arr[out_r:out_r + rh, out_c:out_c + rw]
+                    session.grid = session.engine.init_grid(
+                        initial=full, seed=session.config.seed)
+            else:
+                grid = np.array(session.grid, dtype=np.uint8, copy=True)
+                for out_r, out_c, r0, c0, rh, rw in rects:
+                    grid[r0:r0 + rh, c0:c0 + rw] = \
+                        arr[out_r:out_r + rh, out_c:out_c + rw]
+                session.grid = grid
+            if generation is not None:
+                if generation < 0:
+                    raise ConfigError(
+                        f"generation must be >= 0, got {generation}")
+                session.generation = int(generation)
+            if self._sharded(session.engine):
+                self._persist(session,
+                              shards=session.engine.shard_snapshots(
+                                  session.grid))
+            elif session.engine is not None:
+                self._persist(session,
+                              grid_np=session.engine.fetch(session.grid))
+            else:
+                self._persist(session, grid_np=np.asarray(
+                    session.grid, dtype=np.uint8))
+            out = {"id": sid, "generation": session.generation,
+                   "x0": x0, "y0": y0, "rows": int(arr.shape[0]),
+                   "cols": int(arr.shape[1]), "written": True}
+        if self.obs is not None:
+            self.obs.event("board_write", sid=sid,
+                           generation=out["generation"], x0=x0, y0=y0,
+                           h=int(arr.shape[0]), w=int(arr.shape[1]))
         self._notify_step(session)
         return out
 

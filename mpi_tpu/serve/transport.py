@@ -113,14 +113,23 @@ class Response:
 class StreamPlan:
     """A negotiated ``GET /stream/<sid>?every=k``: the aio front turns
     this into a chunked-transfer push stream of binary frames.  Fronts
-    that cannot stream never see one — the core answers 501 for them."""
+    that cannot stream never see one — the core answers 501 for them.
 
-    __slots__ = ("sid", "every", "code")
+    ``window`` (``(x0, y0, h, w)`` or None) restricts pushes to one
+    viewport; ``delta`` switches the stream to dirty-tile delta frames
+    with a keyframe on subscribe and every ``keyframe_every`` pushes."""
 
-    def __init__(self, sid: str, every: int):
+    __slots__ = ("sid", "every", "code", "window", "delta",
+                 "keyframe_every")
+
+    def __init__(self, sid: str, every: int, window=None,
+                 delta: bool = False, keyframe_every: int = 64):
         self.sid = sid
         self.every = int(every)
         self.code = 200
+        self.window = window
+        self.delta = bool(delta)
+        self.keyframe_every = int(keyframe_every)
 
 
 def json_response(code: int, payload: dict, close: bool = False) -> Response:
@@ -272,6 +281,30 @@ class AppCore:
 
     def _wants_binary(self, req: Request) -> bool:
         return wire.GRID_MEDIA_TYPE in (req.headers.get("Accept") or "")
+
+    def _viewport(self, req: Request) -> Optional[Tuple[int, int, int, int]]:
+        """The request's viewport ``(x0, y0, h, w)`` from its ``x0``,
+        ``y0``, ``h``, ``w`` query parameters, or None when none are
+        present.  Partial windows are an error — a typo'd parameter must
+        not silently serve the full board."""
+        qs = parse_qs(urlsplit(req.path).query)
+        names = ("x0", "y0", "h", "w")
+        present = [n for n in names if n in qs]
+        if not present:
+            return None
+        missing = [n for n in names if n not in qs]
+        if missing:
+            raise ConfigError(
+                f"viewport needs all of x0,y0,h,w (missing: "
+                f"{','.join(missing)})")
+        vals = []
+        for n in names:
+            raw = qs[n][0]
+            try:
+                vals.append(int(raw))
+            except (TypeError, ValueError):
+                raise ConfigError(f"{n} must be an int, got {raw!r}")
+        return tuple(vals)
 
     def _sends_binary(self, req: Request) -> bool:
         ct = (req.headers.get("Content-Type") or "").split(";")[0].strip()
@@ -552,7 +585,7 @@ class AppCore:
                 return json_response(501, {
                     "error": "streaming needs the selector front "
                              "(start the server with --front aio)"})
-            mgr.get(sid)                # unknown session -> 404 at setup
+            session = mgr.get(sid)      # unknown session -> 404 at setup
             qs = parse_qs(urlsplit(req.path).query)
             raw = qs["every"][0] if "every" in qs else "1"
             try:
@@ -561,7 +594,26 @@ class AppCore:
                 raise ConfigError(f"every must be an int, got {raw!r}")
             if every < 1:
                 raise ConfigError(f"every must be >= 1, got {every}")
-            return StreamPlan(sid, every)
+            window = self._viewport(req)
+            if window is not None:
+                # validate NOW so a bad viewport answers 400 at setup,
+                # never a dead stream later
+                cfg = session.config
+                mgr.window_rects(window[0], window[1], window[2],
+                                 window[3], cfg.rows, cfg.cols,
+                                 cfg.boundary)
+            delta = self._query_flag(req, "delta")
+            raw_k = qs.get("keyframe_every", ["64"])[0]
+            try:
+                keyframe_every = int(raw_k)
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"keyframe_every must be an int, got {raw_k!r}")
+            if keyframe_every < 1:
+                raise ConfigError(
+                    f"keyframe_every must be >= 1, got {keyframe_every}")
+            return StreamPlan(sid, every, window=window, delta=delta,
+                              keyframe_every=keyframe_every)
         if kind == "session" and sid is not None:
             if method == "POST" and verb == "step":
                 body = self._body(req, transport)
@@ -584,8 +636,15 @@ class AppCore:
                     200, mgr.step(sid, steps, timeout_s=timeout_s))
             if method == "PUT" and verb == "board":
                 return self._write_board(req, sid, transport)
-            if method == "GET" and verb == "snapshot":
+            if method == "GET" and verb in ("snapshot", "board"):
+                # /board is the windowed-read alias of /snapshot: both
+                # accept ?x0=&y0=&h=&w= and serve O(viewport) bytes
                 timeout_override = self._timeout_override(req, {})
+                window = self._viewport(req)
+                if window is not None:
+                    return self._window_snapshot(
+                        sid, req, transport, window,
+                        timeout_s=timeout_override)
                 if self._wants_binary(req):
                     return self._binary_snapshot(sid, req, transport,
                                                  timeout_s=timeout_override)
@@ -919,13 +978,53 @@ class AppCore:
         return wire.encode_frame(grid, generation=generation,
                                  rule=config.rule, boundary=config.boundary)
 
+    def _window_snapshot(self, sid: str, req: Request, transport: str,
+                         window: Tuple[int, int, int, int],
+                         timeout_s: Optional[float] = None) -> Response:
+        """One viewport read: O(viewport) device bytes (per-shard
+        fetch inside the manager) and O(viewport) wire bytes (a v2
+        windowed frame, or the JSON window shape)."""
+        x0, y0, h, w = window
+        grid, generation, config = self.manager.snapshot_window(
+            sid, x0, y0, h, w, timeout_s=timeout_s)
+        if self._wants_binary(req):
+            t0 = time.perf_counter()
+            frame = wire.encode_window_frame(
+                grid, x0=x0, y0=y0,
+                board_shape=(config.rows, config.cols),
+                generation=generation, rule=config.rule,
+                boundary=config.boundary)
+            self._observe_encode(t0, "binary", transport)
+            if self.obs is not None:
+                self.obs.viewport_bytes.inc(len(frame),
+                                            transport=transport)
+            return Response(200, frame, wire.GRID_MEDIA_TYPE)
+        t0 = time.perf_counter()
+        payload = {"id": sid, "generation": generation,
+                   "board_rows": config.rows, "board_cols": config.cols,
+                   "x0": x0, "y0": y0, "rows": h, "cols": w,
+                   "grid": format_grid_rows(grid)}
+        body = json.dumps(payload).encode()
+        self._observe_encode(t0, "json", transport)
+        if self.obs is not None:
+            self.obs.viewport_bytes.inc(len(body), transport=transport)
+        return Response(200, body, "application/json")
+
     def _write_board(self, req: Request, sid: str,
                      transport: str) -> Response:
+        window = None
         if self._sends_binary(req):
             raw = self._raw_body(req, transport)
             t0 = time.perf_counter()
             grid, meta = wire.decode_frame(raw)
             self._observe_decode(t0, "binary", transport)
+            if meta["is_delta"]:
+                raise ConfigError(
+                    "board writes take full or windowed frames, "
+                    "not delta frames")
+            if meta["window"] is not None:
+                wx0, wy0, _, _ = meta["window"]
+                window = (wx0, wy0)
             generation = (meta["generation"] if meta["has_generation"]
                           else None)
             timeout_s = self._timeout_override(req, {})
@@ -938,10 +1037,23 @@ class AppCore:
             t0 = time.perf_counter()
             grid = parse_grid_rows(body["grid"])
             self._observe_decode(t0, "json", transport)
+            x0, y0 = body.get("x0"), body.get("y0")
+            if (x0 is None) != (y0 is None):
+                raise ConfigError(
+                    "a region write needs both x0 and y0")
+            if x0 is not None:
+                if not isinstance(x0, int) or not isinstance(y0, int):
+                    raise ConfigError(
+                        f"x0/y0 must be ints, got {x0!r}/{y0!r}")
+                window = (x0, y0)
             generation = body.get("generation")
             if generation is not None and not isinstance(generation, int):
                 raise ConfigError(
                     f"generation must be an int, got {generation!r}")
+        if window is not None:
+            return json_response(200, self.manager.write_window(
+                sid, window[0], window[1], grid, generation=generation,
+                timeout_s=timeout_s))
         return json_response(200, self.manager.write_board(
             sid, grid, generation=generation, timeout_s=timeout_s))
 

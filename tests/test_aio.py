@@ -428,7 +428,8 @@ def test_stream_drop_to_latest_backpressure():
         conn = _Conn(a)
         srv._conns[conn.fd] = conn
         conn.stream = {"sid": "sX", "every": 1, "last": None,
-                       "dirty": False}
+                       "dirty": False, "delta": False, "window": None,
+                       "key_pending": False}
         conn.busy = True
 
         grid = np.ones((8, 8), dtype=np.uint8)

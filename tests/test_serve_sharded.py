@@ -1,0 +1,334 @@
+"""Mesh-sharded serving sessions (ISSUE 20): O(viewport) reads,
+region writes, per-shard checkpointing, failover adoption, and the
+dirty-tile delta stream — all against the 2x4 virtual CPU mesh the
+conftest provisions, with the 1x1 session and the serial NumPy oracle
+as the bit-exactness references.
+
+The headline property is the acceptance criterion: every surface a
+client can observe (board reads, windowed reads, writes, restores,
+adopted sessions, streamed frames) is bit-identical between a sharded
+session and a single-device one — sharding is a layout, never a
+semantic.
+
+The mesh tests compile 2x4 ``shard_map`` steppers, so their ids live
+in ``tests/tier1_slow_ids.txt``; the pure-geometry and host-path tests
+stay tier-1.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_tpu.backends.serial_np import evolve_np
+from mpi_tpu.config import ConfigError
+from mpi_tpu.models.rules import LIFE
+from mpi_tpu.serve import recovery, wire
+from mpi_tpu.serve.session import SessionManager
+from mpi_tpu.utils.hashinit import init_tile_np
+
+R, C = 64, 96                           # 2x4 mesh -> 32x24 device shards
+SEED = 5
+
+
+def _spec(mesh=None, boundary="periodic", seed=SEED, backend="tpu"):
+    s = {"rows": R, "cols": C, "backend": backend, "seed": seed,
+         "boundary": boundary}
+    if mesh is not None:
+        s["mesh"] = mesh
+    return s
+
+
+def _oracle(steps, seed=SEED, boundary="periodic"):
+    return evolve_np(init_tile_np(R, C, seed), steps, LIFE, boundary)
+
+
+def _board(mgr, sid):
+    grid, _gen, _config = mgr.snapshot_array(sid)
+    return np.asarray(grid, dtype=np.uint8)
+
+
+# ----------------------------------------------- window geometry (tier-1)
+
+
+def test_window_rects_interior_is_one_rect():
+    rects = SessionManager.window_rects(10, 20, 8, 16, R, C, "periodic")
+    assert rects == [(0, 0, 10, 20, 8, 16)]
+
+
+def test_window_rects_periodic_wrap_decomposes():
+    # wraps both axes -> 4 non-wrapping rectangles covering the window
+    rects = SessionManager.window_rects(60, 90, 8, 12, R, C, "periodic")
+    assert len(rects) == 4
+    cover = np.zeros((8, 12), dtype=np.int32)
+    for out_r, out_c, r0, c0, rh, rw in rects:
+        assert 0 <= r0 < R and 0 <= c0 < C
+        assert r0 + rh <= R and c0 + rw <= C      # never wraps on-board
+        cover[out_r:out_r + rh, out_c:out_c + rw] += 1
+    assert (cover == 1).all()                     # exact partition
+
+
+def test_window_rects_rejections():
+    with pytest.raises(ConfigError):              # dead boards don't wrap
+        SessionManager.window_rects(60, 0, 8, 4, R, C, "dead")
+    with pytest.raises(ConfigError):              # empty extent
+        SessionManager.window_rects(0, 0, 0, 4, R, C, "periodic")
+    with pytest.raises(ConfigError):              # origin off the board
+        SessionManager.window_rects(R, 0, 1, 1, R, C, "periodic")
+    with pytest.raises(ConfigError):              # window bigger than board
+        SessionManager.window_rects(0, 0, R + 1, 1, R, C, "periodic")
+
+
+# ------------------------------------------ host-path viewport (tier-1)
+
+
+def test_host_session_viewport_and_wrap():
+    mgr = SessionManager()
+    sid = mgr.create(_spec(backend="serial"))["id"]
+    full = _board(mgr, sid)
+    win, gen, _ = mgr.snapshot_window(sid, 10, 20, 8, 16)
+    assert gen == 0
+    assert np.array_equal(win, full[10:18, 20:36])
+    wrapped, _, _ = mgr.snapshot_window(sid, 60, 90, 8, 12)
+    rows = [(60 + i) % R for i in range(8)]
+    cols = [(90 + j) % C for j in range(12)]
+    assert np.array_equal(wrapped, full[np.ix_(rows, cols)])
+
+
+def test_host_session_region_write():
+    mgr = SessionManager()
+    sid = mgr.create(_spec(backend="serial"))["id"]
+    patch = (np.arange(5 * 9).reshape(5, 9) % 2).astype(np.uint8)
+    out = mgr.write_window(sid, 3, 7, patch)
+    assert out["written"] and (out["rows"], out["cols"]) == (5, 9)
+    assert np.array_equal(_board(mgr, sid)[3:8, 7:16], patch)
+
+
+# ------------------------------------------- mesh parity (slow: compiles)
+
+
+def test_mesh_board_read_parity():
+    mgr = SessionManager()
+    solo = mgr.create(_spec(mesh="1x1"))["id"]
+    mesh = mgr.create(_spec(mesh="2x4"))["id"]
+    for sid in (solo, mesh):
+        mgr.step(sid, 7)
+    a, b = _board(mgr, solo), _board(mgr, mesh)
+    assert np.array_equal(a, b)
+    assert np.array_equal(b, _oracle(7))
+
+
+def test_mesh_viewport_crosses_shard_seams():
+    mgr = SessionManager()
+    sid = mgr.create(_spec(mesh="2x4"))["id"]
+    full = _board(mgr, sid)
+    # 32x24 shards: this window straddles the row seam and two col seams
+    win, _, _ = mgr.snapshot_window(sid, 28, 20, 9, 30)
+    assert np.array_equal(win, full[28:37, 20:50])
+    # single-shard interior window, and one pinned to the far corner
+    win, _, _ = mgr.snapshot_window(sid, 1, 1, 4, 4)
+    assert np.array_equal(win, full[1:5, 1:5])
+    win, _, _ = mgr.snapshot_window(sid, R - 3, C - 5, 3, 5)
+    assert np.array_equal(win, full[R - 3:, C - 5:])
+
+
+def test_mesh_viewport_periodic_wrap():
+    mgr = SessionManager()
+    sid = mgr.create(_spec(mesh="2x4"))["id"]
+    full = _board(mgr, sid)
+    win, _, _ = mgr.snapshot_window(sid, 61, 93, 7, 9)
+    rows = [(61 + i) % R for i in range(7)]
+    cols = [(93 + j) % C for j in range(9)]
+    assert np.array_equal(win, full[np.ix_(rows, cols)])
+    # a dead-boundary mesh session answers 400-shaped errors on wrap
+    dead = mgr.create(_spec(mesh="2x4", boundary="dead"))["id"]
+    with pytest.raises(ConfigError):
+        mgr.snapshot_window(dead, 61, 0, 7, 4)
+
+
+def test_mesh_region_write_parity():
+    mgr = SessionManager()
+    solo = mgr.create(_spec(mesh="1x1"))["id"]
+    mesh = mgr.create(_spec(mesh="2x4"))["id"]
+    serial = mgr.create(_spec(backend="serial"))["id"]
+    rng = np.random.default_rng(40)
+    patch = rng.integers(0, 2, size=(9, 30)).astype(np.uint8)
+    for sid in (solo, mesh, serial):
+        out = mgr.write_window(sid, 28, 20, patch)  # crosses 3 shard seams
+        assert out["written"]
+        mgr.step(sid, 5)
+    a, b, c = _board(mgr, solo), _board(mgr, mesh), _board(mgr, serial)
+    assert np.array_equal(a, b)
+    assert np.array_equal(b, c)
+
+
+def test_mesh_region_write_periodic_wrap():
+    mgr = SessionManager()
+    sid = mgr.create(_spec(mesh="2x4"))["id"]
+    before = _board(mgr, sid)
+    rng = np.random.default_rng(41)
+    patch = rng.integers(0, 2, size=(6, 10)).astype(np.uint8)
+    mgr.write_window(sid, 61, 92, patch)
+    rows = [(61 + i) % R for i in range(6)]
+    cols = [(92 + j) % C for j in range(10)]
+    expect = before.copy()
+    expect[np.ix_(rows, cols)] = patch
+    assert np.array_equal(_board(mgr, sid), expect)
+
+
+def test_mesh_write_generation_rebase():
+    mgr = SessionManager()
+    sid = mgr.create(_spec(mesh="2x4"))["id"]
+    mgr.step(sid, 3)
+    patch = np.ones((4, 4), dtype=np.uint8)
+    out = mgr.write_window(sid, 0, 0, patch, generation=90)
+    assert out["generation"] == 90
+    _, gen, _ = mgr.snapshot_array(sid)
+    assert gen == 90
+
+
+# ---------------------------- per-shard checkpointing (slow: compiles)
+
+
+def test_sharded_checkpoint_is_shard_form_and_restores(tmp_path):
+    k, m = 5, 4
+    m1 = SessionManager(state_dir=str(tmp_path), checkpoint_every=2)
+    sid = m1.create(_spec(mesh="2x4"))["id"]
+    m1.step(sid, k)
+    m1.checkpoint_now(sid)
+    before = _board(m1, sid)
+    rec = recovery.StateStore(str(tmp_path)).load_record(sid)
+    snap = rec["snapshot"]
+    assert "shards" in snap and len(snap["shards"]) > 1
+    assert "packed" not in snap          # never a full-board payload
+    cover = np.zeros((R, C), dtype=np.int32)   # shards partition the board
+    for sh in snap["shards"]:
+        cover[sh["r0"]:sh["r0"] + sh["rows"],
+              sh["c0"]:sh["c0"] + sh["cols"]] += 1
+    assert (cover == 1).all()
+    assert np.array_equal(recovery.decode_grid(snap), before)
+
+    m2 = SessionManager(state_dir=str(tmp_path))    # the "restart"
+    assert m2.restored_sessions == 1
+    assert np.array_equal(_board(m2, sid), before)
+    m2.step(sid, m)
+    assert np.array_equal(_board(m2, sid), _oracle(k + m))
+
+
+def test_legacy_full_grid_record_restores_on_mesh(tmp_path):
+    """Pre-shard records (a single packed payload) restore unchanged —
+    the MIGRATION.md compatibility promise."""
+    store = recovery.StateStore(str(tmp_path))
+    grid = init_tile_np(R, C, SEED)
+    snap = recovery.encode_grid(grid)
+    snap["generation"] = 0
+    store.save("s1", _spec(mesh="2x4"), 0, snap)
+    mgr = SessionManager(state_dir=str(tmp_path))
+    assert mgr.restored_sessions == 1
+    assert np.array_equal(_board(mgr, "s1"), grid)
+    mgr.step("s1", 3)
+    assert np.array_equal(_board(mgr, "s1"), _oracle(3))
+
+
+def test_release_adopt_parity_shard_records(tmp_path):
+    """Failover: a sharded session drained on one manager and adopted
+    by another (shared state dir) is bit-identical, and the adoption
+    restores from the per-shard record."""
+    m2 = SessionManager(state_dir=str(tmp_path))    # the successor, idle
+    m1 = SessionManager(state_dir=str(tmp_path))
+    sid = m1.create(_spec(mesh="2x4"))["id"]
+    m1.step(sid, 6)
+    m1.checkpoint_now(sid)
+    before = _board(m1, sid)
+    m1.release(sid)
+    with pytest.raises(KeyError):
+        m1.get(sid)
+    assert m2.adopt_session(sid)
+    assert np.array_equal(_board(m2, sid), before)
+    m2.step(sid, 2)
+    assert np.array_equal(_board(m2, sid), _oracle(8))
+    assert not m2.adopt_session("nope")
+
+
+# --------------------- delta stream == keyframe stream (slow: compiles)
+
+
+def test_delta_stream_reconstruction_matches_keyframes():
+    """Over real aio HTTP: a windowed delta stream folded through
+    ``wire.apply_delta`` reproduces, at every generation, exactly the
+    frame the keyframe stream ships."""
+    import http.client
+    import json
+    import socket as socketlib
+    import threading
+
+    from mpi_tpu.serve.aio import make_aio_server
+
+    mgr = SessionManager()
+    srv = make_aio_server(port=0, manager=mgr)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    host, port = srv.server_address[:2]
+    socks = []
+
+    def call(method, path, body=None):
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request(method, path,
+                  body=json.dumps(body).encode() if body else None)
+        resp = c.getresponse()
+        raw = resp.read()
+        assert resp.status == 200, (resp.status, raw[:200])
+        c.close()
+        return raw
+
+    def open_stream(query):
+        s = socketlib.create_connection((host, port), timeout=60)
+        s.sendall(f"GET {query} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += s.recv(65536)
+        socks.append(s)
+        return s, bytearray(buf.split(b"\r\n\r\n", 1)[1])
+
+    def read_frame(s, buf):
+        while b"\r\n" not in buf:
+            buf += s.recv(65536)
+        head, rest = bytes(buf).split(b"\r\n", 1)
+        size = int(head, 16)
+        buf[:] = rest
+        while len(buf) < size + 2:
+            buf += s.recv(65536)
+        frame = bytes(buf[:size])
+        buf[:] = buf[size + 2:]
+        return wire.decode_frame(frame)
+
+    try:
+        sid = mgr.create(_spec(mesh="2x4"))["id"]
+        window = (28, 20, 16, 32)                 # crosses shard seams
+        q = (f"x0={window[0]}&y0={window[1]}"
+             f"&h={window[2]}&w={window[3]}&every=1")
+        sk, kbuf = open_stream(f"/stream/{sid}?{q}")
+        sd, dbuf = open_stream(f"/stream/{sid}?{q}&delta=1")
+        kgrid, kmeta = read_frame(sk, kbuf)       # subscribe frames
+        dgrid, dmeta = read_frame(sd, dbuf)
+        assert not dmeta["is_delta"]              # first frame: keyframe
+        assert np.array_equal(kgrid, dgrid)
+        recon = dgrid
+        for gen in range(1, 5):
+            call("POST", f"/sessions/{sid}/step", {"steps": 1})
+            kgrid, kmeta = read_frame(sk, kbuf)
+            dg, dm = read_frame(sd, dbuf)
+            assert kmeta["generation"] == dm["generation"] == gen
+            recon = dg if dg is not None \
+                else wire.apply_delta(recon, dm["tiles"])
+            assert np.array_equal(recon, kgrid)
+        # ...and the stream window is the real board slice
+        win, _, _ = mgr.snapshot_window(sid, *window)
+        assert np.array_equal(recon, win)
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        srv.shutdown()
+        srv.server_close()
+        thread.join(timeout=10)

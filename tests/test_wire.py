@@ -199,3 +199,139 @@ def test_recovery_decode_round_trip_non_multiple_of_8():
     grid = (np.arange(7 * 13).reshape(7, 13) % 3 == 0).astype(np.uint8)
     snap = recovery.encode_grid(grid)
     assert np.array_equal(recovery.decode_grid(snap), grid)
+
+
+# --------------------------------------------- v2: windowed + delta frames
+
+
+def test_window_frame_round_trip():
+    rng = np.random.default_rng(20)
+    win = rng.integers(0, 2, size=(17, 33)).astype(np.uint8)
+    frame = wire.encode_window_frame(
+        win, x0=5, y0=9, board_shape=(64, 96), generation=12,
+        rule=LIFE, boundary="periodic")
+    assert len(frame) == wire.HEADER_V2_LEN + (17 * 33 + 7) // 8
+    out, meta = wire.decode_frame(frame)
+    assert np.array_equal(out, win)
+    assert meta["version"] == wire.VERSION_WINDOW
+    assert meta["window"] == (5, 9, 17, 33)
+    assert (meta["board_rows"], meta["board_cols"]) == (64, 96)
+    assert meta["generation"] == 12 and meta["has_generation"]
+    assert not meta["is_delta"]
+
+
+def test_delta_frame_round_trip_and_heartbeat():
+    rng = np.random.default_rng(21)
+    tiles = [(0, 0, rng.integers(0, 2, size=(8, 8)).astype(np.uint8)),
+             (16, 24, rng.integers(0, 2, size=(4, 7)).astype(np.uint8))]
+    frame = wire.encode_delta_frame(
+        tiles, window=(2, 3, 32, 40), board_shape=(128, 128),
+        generation=7)
+    grid, meta = wire.decode_frame(frame)
+    assert grid is None and meta["is_delta"]
+    assert meta["window"] == (2, 3, 32, 40)
+    assert len(meta["tiles"]) == 2
+    for (wr, wc, wt), (gr, gc, gt) in zip(tiles, meta["tiles"]):
+        assert (wr, wc) == (gr, gc)
+        assert np.array_equal(wt, gt)
+    # the empty delta is the quiescent heartbeat: v2 header + the count
+    beat = wire.encode_delta_frame(
+        [], window=(0, 0, 32, 40), board_shape=(128, 128))
+    assert len(beat) == wire.HEADER_V2_LEN + 4
+    _, bm = wire.decode_frame(beat)
+    assert bm["is_delta"] and bm["tiles"] == []
+
+
+def test_diff_tiles_apply_delta_reconstruction():
+    rng = np.random.default_rng(22)
+    prev = rng.integers(0, 2, size=(130, 70)).astype(np.uint8)
+    cur = prev.copy()
+    cur[0, 0] ^= 1                      # first tile
+    cur[129, 69] ^= 1                   # ragged last tile
+    cur[65, 10] ^= 1                    # a middle block
+    tiles = wire.diff_tiles(prev, cur)
+    # 3 flipped cells in 3 distinct 64x64 blocks
+    assert len(tiles) == 3
+    assert np.array_equal(wire.apply_delta(prev, tiles), cur)
+    assert wire.diff_tiles(cur, cur) == []
+    with pytest.raises(WireError, match="shape"):
+        wire.diff_tiles(prev, cur[:10])
+
+
+def test_delta_round_trips_through_the_wire():
+    rng = np.random.default_rng(23)
+    prev = rng.integers(0, 2, size=(90, 90)).astype(np.uint8)
+    cur = prev.copy()
+    cur[rng.integers(0, 90, 30), rng.integers(0, 90, 30)] ^= 1
+    frame = wire.encode_delta_frame(
+        wire.diff_tiles(prev, cur), window=(0, 0, 90, 90),
+        board_shape=(90, 90), generation=3)
+    _, meta = wire.decode_frame(frame)
+    assert np.array_equal(wire.apply_delta(prev, meta["tiles"]), cur)
+
+
+def test_delta_tile_escaping_window_rejected():
+    tile = np.ones((8, 8), dtype=np.uint8)
+    with pytest.raises(WireError, match="escapes"):
+        wire.encode_delta_frame([(28, 0, tile)], window=(0, 0, 32, 32),
+                                board_shape=(64, 64))
+
+
+def test_v2_truncated_and_malformed_headers_rejected():
+    frame = wire.encode_window_frame(
+        np.ones((8, 8), dtype=np.uint8), x0=0, y0=0, board_shape=(16, 16))
+    # a v2 frame cut inside the 16-byte window extension (40 < 48)
+    with pytest.raises(WireError, match="truncated"):
+        wire.parse_header(frame[:40])
+    with pytest.raises(WireError):
+        wire.decode_frame(frame[:-1])
+    # delta flag on a v1 frame is a protocol violation
+    v1 = bytearray(wire.encode_frame(np.ones((8, 8), dtype=np.uint8)))
+    v1[5] |= wire.FLAG_DELTA
+    with pytest.raises(WireError, match="delta flag"):
+        wire.parse_header(bytes(v1))
+    # window origin off the board
+    bad = bytearray(frame)
+    wire.WINDOW_EXT.pack_into(bad, wire.HEADER_LEN, 16, 0, 16, 16)
+    with pytest.raises(WireError, match="off the"):
+        wire.parse_header(bytes(bad))
+
+
+def test_header_len_of_prefix_contract():
+    v1 = wire.encode_frame(np.ones((4, 4), dtype=np.uint8))
+    v2 = wire.encode_window_frame(
+        np.ones((4, 4), dtype=np.uint8), x0=0, y0=0, board_shape=(8, 8))
+    assert wire.header_len_of(v1) == wire.HEADER_LEN
+    assert wire.header_len_of(v2) == wire.HEADER_V2_LEN
+    assert wire.header_len_of(v2[:4]) is None       # wait for more
+    with pytest.raises(WireError, match="magic"):
+        wire.header_len_of(b"XXXXX")
+
+
+def test_split_frames_mixed_versions_byte_at_a_time():
+    rng = np.random.default_rng(24)
+    win = rng.integers(0, 2, size=(6, 10)).astype(np.uint8)
+    frames = [
+        wire.encode_frame(rng.integers(0, 2, size=(5, 11)).astype(np.uint8),
+                          generation=0),
+        wire.encode_window_frame(win, x0=1, y0=2, board_shape=(32, 32),
+                                 generation=1),
+        wire.encode_delta_frame(
+            [(0, 0, win[:4, :4])], window=(1, 2, 6, 10),
+            board_shape=(32, 32), generation=2),
+        wire.encode_delta_frame([], window=(0, 0, 6, 10),
+                                board_shape=(32, 32), generation=3),
+    ]
+    stream = b"".join(frames)
+    buf = b""
+    seen = []
+    for i in range(len(stream)):        # worst case: one byte per feed
+        buf += stream[i:i + 1]
+        out, buf = wire.split_frames(buf)
+        seen.extend(out)
+    assert buf == b"" and len(seen) == 4
+    for gen, (g, meta) in enumerate(seen):
+        assert meta["generation"] == gen
+    assert np.array_equal(seen[1][0], win)
+    assert seen[2][0] is None and len(seen[2][1]["tiles"]) == 1
+    assert seen[3][1]["tiles"] == []

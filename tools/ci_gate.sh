@@ -18,6 +18,10 @@
 #                                    #   (bench.py --serve-flight, <2%
 #                                    #   paired-median; wall-clock —
 #                                    #   arm on quiet boxes only)
+#   VIEWPORT_GATE=1 tools/ci_gate.sh # + viewport byte gates (bench.py
+#                                    #   --serve-viewport; byte ratios,
+#                                    #   not wall-clock — safe anywhere
+#                                    #   with ~1 GiB of headroom)
 #   STATE_SCRUB=/path tools/ci_gate.sh  # + offline state-dir scrub
 #                                    #   (verify-only) over that dir
 #
@@ -78,6 +82,19 @@ fi
 if [ "${FLIGHT_GATE:-0}" = "1" ]; then
     note "flight overhead gate (bench.py --serve-flight)"
     python bench.py --serve-flight | python -c '
+import json, sys
+doc = json.loads(sys.stdin.readline())
+print(json.dumps(doc, indent=2))
+sys.exit(0 if doc.get("ok") else 1)'
+    track $?
+fi
+
+# Off by default only because it allocates a 16384^2 board: the gated
+# numbers are BYTE ratios (windowed read vs full board, quiescent
+# delta stream vs keyframes), deterministic on any runner.
+if [ "${VIEWPORT_GATE:-0}" = "1" ]; then
+    note "viewport byte gate (bench.py --serve-viewport)"
+    python bench.py --serve-viewport | python -c '
 import json, sys
 doc = json.loads(sys.stdin.readline())
 print(json.dumps(doc, indent=2))

@@ -437,6 +437,20 @@ def main():
         assert resp.status == 200, f"binary board write -> {resp.status}"
         if not json.loads(body).get("written"):
             raise ValueError(f"board write not acknowledged: {body!r}")
+        # windowed O(viewport) read (ISSUE 20): a v2 frame carrying the
+        # window origin and the full board dims, counted by the viewport
+        # byte counter and timed per device shard
+        hc.request("GET", f"/sessions/{sid_a}/board?x0=8&y0=8&h=16&w=16",
+                   headers={"Accept": wire_mod.GRID_MEDIA_TYPE})
+        resp = hc.getresponse()
+        wframe = resp.read()
+        assert resp.status == 200, f"windowed board read -> {resp.status}"
+        wgrid, wmeta = wire_mod.decode_frame(wframe)
+        if wgrid.shape != (16, 16) or wmeta.get("window") != (8, 8, 16, 16) \
+                or (wmeta.get("board_rows"),
+                    wmeta.get("board_cols")) != (64, 64):
+            raise ValueError(f"windowed read meta drifted: "
+                             f"shape={wgrid.shape} meta={wmeta}")
         hc.close()
 
         aio_srv = make_aio_server(port=0, manager=manager)
@@ -448,18 +462,26 @@ def main():
 
             ahost, aport = aio_srv.server_address[:2]
             s = socket_mod.create_connection((ahost, aport), timeout=30)
-            s.sendall(f"GET /stream/{sid_a}?every=1 HTTP/1.1\r\n"
+            # a windowed dirty-tile delta stream (ISSUE 20): the first
+            # frame is a keyframe, every later one a delta — both kinds
+            # must land in the delta-frame counter and the windowed
+            # frames in the aio viewport byte counter
+            s.sendall(f"GET /stream/{sid_a}?every=1&delta=1"
+                      f"&x0=0&y0=0&h=64&w=64 HTTP/1.1\r\n"
                       f"Host: x\r\n\r\n".encode())
             buf = b""
             while b"\r\n\r\n" not in buf:       # the chunked head
                 buf += s.recv(65536)
-            step(sid_a)                          # commit -> frame push
+            step(sid_a)                          # commit -> delta push
+            step(sid_a)
             deadline = time.monotonic() + 30
-            while (aio_srv.stats()["frames_pushed"] < 1
+            while (aio_srv.stats()["frames_pushed"] < 2
                    and time.monotonic() < deadline):
                 time.sleep(0.02)
-            if aio_srv.stats()["frames_pushed"] < 1:
-                raise ValueError("aio stream pushed no frames")
+            if aio_srv.stats()["frames_pushed"] < 2:
+                raise ValueError("aio delta stream pushed "
+                                 f"{aio_srv.stats()['frames_pushed']} "
+                                 f"frames, expected >= 2 (key + delta)")
             s.close()
         finally:
             aio_srv.shutdown()
@@ -660,6 +682,28 @@ def main():
             raise ValueError(
                 f"mpi_tpu_aio_frames_pushed_total = {pushed}, expected "
                 f">= 1 after the stream smoke")
+        # the viewport surfaces moved real bytes on BOTH fronts (the
+        # windowed threaded read above, the windowed aio delta stream),
+        # the delta stream pushed at least one keyframe and one delta,
+        # and the windowed read timed its device-shard transfers
+        vp = {}
+        for n, labels, v in samples:
+            if n == "mpi_tpu_viewport_bytes_total":
+                t = labels.get("transport")
+                vp[t] = vp.get(t, 0.0) + v
+        if vp.get("threaded", 0) <= 0 or vp.get("aio", 0) <= 0:
+            raise ValueError(f"mpi_tpu_viewport_bytes_total counted no "
+                             f"bytes on some front: {vp}")
+        kinds = {labels.get("kind"): v for n, labels, v in samples
+                 if n == "mpi_tpu_delta_frames_total"}
+        if kinds.get("key", 0) < 1 or kinds.get("delta", 0) < 1:
+            raise ValueError(f"mpi_tpu_delta_frames_total rows drifted "
+                             f"after the delta stream: {kinds}")
+        shard_fetches = sum(v for n, _, v in samples
+                            if n == "mpi_tpu_shard_fetch_seconds_count")
+        if shard_fetches < 1:
+            raise ValueError("mpi_tpu_shard_fetch_seconds never observed "
+                             "a device-shard window transfer")
         http_total = sum(v for n, _, v in samples
                          if n == "mpi_tpu_http_requests_total")
         # 30 requests precede the scrape, but the counter increments
